@@ -1,0 +1,38 @@
+"""consensus_tpu — TPU-native framework for fair consensus statement generation.
+
+A ground-up JAX/XLA/pjit re-design of the capabilities of
+``cartgr/Generating-Fair-Consensus-Statements-with-Social-Choice-on-Token-Level-MDPs``
+(AAMAS 2026): token-level MDP decoders (best-of-N, beam search, finite
+lookahead, MCTS), the Habermas Machine deliberation loop, social-choice
+welfare objectives (egalitarian / utilitarian / log-Nash, Schulze preference
+aggregation), an experiment sweep engine, and a multi-metric evaluation +
+aggregation pipeline.
+
+Where the reference drives every model interaction through a rate-limited
+HTTP API (reference ``src/utils.py:69-74``), this framework routes all
+generation and scoring through a pluggable :class:`~consensus_tpu.backends.Backend`
+whose primary implementation runs a TPU-resident Gemma/Llama model: candidate
+rollouts and the (candidates x agents) utility tensor are computed as batched,
+sharded on-device forward passes.
+
+Layer map (mirrors reference SURVEY §1, L1+L2 collapsed into backends/):
+
+    cli runners        run_experiment.py, run_experiment_with_eval.py, ...
+    aggregation        consensus_tpu.aggregation
+    evaluation         consensus_tpu.evaluation
+    experiment engine  consensus_tpu.experiment
+    decoding methods   consensus_tpu.methods
+    social choice      consensus_tpu.social_choice
+    backends           consensus_tpu.backends (fake / tpu / api)
+    model runtime      consensus_tpu.models (pure-JAX transformers)
+    device ops         consensus_tpu.ops (welfare reductions, attention kernels)
+    parallelism        consensus_tpu.parallel (mesh, shardings, ring attention)
+    theory             consensus_tpu.theory (NW lottery, coalition blocking)
+"""
+
+__version__ = "0.1.0"
+
+from consensus_tpu.utils.identifiers import (  # noqa: F401
+    IMPORTANT_PARAMETERS,
+    create_method_identifier,
+)

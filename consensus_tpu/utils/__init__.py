@@ -1,0 +1,5 @@
+from consensus_tpu.utils.identifiers import (  # noqa: F401
+    IMPORTANT_PARAMETERS,
+    create_method_identifier,
+    parse_method_identifier,
+)

@@ -1,0 +1,15 @@
+"""Test harness configuration.
+
+Forces JAX onto the host CPU platform with 8 virtual devices so every
+sharding/mesh test runs mesh-shape-faithfully without TPU hardware.  Must run
+before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,47 @@
+"""Method-identifier round-trip tests (reference src/utils.py:19-62 semantics)."""
+
+from consensus_tpu.utils.identifiers import (
+    create_method_identifier,
+    normalize_method_name,
+    parse_method_identifier,
+)
+
+
+def test_create_basic():
+    assert create_method_identifier("zero_shot") == "zero_shot"
+
+
+def test_create_filters_unimportant_params_and_sorts():
+    key = create_method_identifier(
+        "best_of_n",
+        {"param_n": 10, "max_tokens": 50, "beta": 1.0, "num_rounds": 2},
+    )
+    # max_tokens/beta are not in IMPORTANT_PARAMETERS; sorted order n < num_rounds
+    assert key == "best_of_n (n=10, num_rounds=2)"
+
+
+def test_create_with_seed():
+    key = create_method_identifier("beam_search", {"beam_width": 4}, True, 42)
+    assert key == "beam_search (beam_width=4) [seed=42]"
+
+
+def test_parse_round_trip():
+    base, params, seed = parse_method_identifier("beam_search (beam_width=4) [seed=42]")
+    assert base == "beam_search"
+    assert params == {"beam_width": 4}
+    assert seed == 42
+
+
+def test_parse_no_params():
+    base, params, seed = parse_method_identifier("habermas_machine")
+    assert base == "habermas_machine" and params == {} and seed is None
+
+
+def test_parse_float_param():
+    _, params, _ = parse_method_identifier("m (beta=0.5)")
+    assert params == {"beta": 0.5}
+
+
+def test_normalize_strips_seed():
+    assert normalize_method_name("best_of_n (n=3) [seed=7]") == "best_of_n (n=3)"
+    assert normalize_method_name("") == "unknown"

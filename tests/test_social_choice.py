@@ -1,0 +1,303 @@
+"""Golden tests for Schulze aggregation and ranking parsers.
+
+The numeric fixtures are the canonical electowiki Schulze examples plus the
+Habermas-paper Figure 1 rounds, matching the correctness anchors the reference
+pins in ``src/methods/tests/test_habermas_schulze.py`` and
+``test_habermas_ranking_parsing.py`` (themselves adapted from Google's
+``schulze_method_test.py``).  Passing these guarantees drop-in behavioural
+parity of the social-choice core.
+"""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.social_choice import (
+    aggregate_schulze,
+    check_arrow_format,
+    check_response_format,
+    compute_pairwise_defeats,
+    compute_strongest_paths,
+    extract_arrow_ranking,
+    extract_statement,
+    parse_arrow_ranking,
+    process_ranking_response,
+    rank_from_path_strengths,
+    schulze_social_ranking,
+)
+
+# ---------------------------------------------------------------------------
+# Electowiki fixtures: (name, ballots, defeats, path strengths, tied ranking)
+# ---------------------------------------------------------------------------
+
+ELECTOWIKI_CASES = [
+    (
+        "ew_30_voters_4_candidates",
+        np.int32(
+            5 * [[0, 2, 1, 3]]
+            + 2 * [[0, 3, 1, 2]]
+            + 3 * [[0, 3, 2, 1]]
+            + 4 * [[1, 0, 2, 3]]
+            + 3 * [[3, 1, 0, 2]]
+            + 3 * [[3, 2, 0, 1]]
+            + 1 * [[1, 3, 2, 0]]
+            + 5 * [[2, 1, 3, 0]]
+            + 4 * [[3, 2, 1, 0]]
+        ),
+        np.int32([[0, 11, 20, 14], [19, 0, 9, 12], [10, 21, 0, 17], [16, 18, 13, 0]]),
+        np.int32([[0, 20, 20, 17], [19, 0, 19, 17], [19, 21, 0, 17], [18, 18, 18, 0]]),
+        np.int32([1, 3, 2, 0]),  # D > C > A > B
+    ),
+    (
+        "ew_9_voters_4_candidates",
+        np.int32(
+            3 * [[0, 1, 2, 3]] + 2 * [[1, 2, 3, 0]] + 2 * [[3, 1, 2, 0]] + 2 * [[3, 1, 0, 2]]
+        ),
+        np.int32([[0, 5, 5, 3], [4, 0, 7, 5], [4, 2, 0, 5], [6, 4, 4, 0]]),
+        np.int32([[0, 5, 5, 5], [5, 0, 7, 5], [5, 5, 0, 5], [6, 5, 5, 0]]),
+        np.int32([1, 0, 1, 0]),  # B=D > A=C
+    ),
+    (
+        "ew_2_voters_4_candidates",
+        np.int32([[0, 0, 1, 2], [0, 1, 3, 2]]),
+        np.int32([[0, 1, 2, 2], [0, 0, 2, 2], [0, 0, 0, 1], [0, 0, 1, 0]]),
+        np.int32([[0, 1, 2, 2], [0, 0, 2, 2], [0, 0, 0, 0], [0, 0, 0, 0]]),
+        np.int32([0, 1, 2, 2]),  # A > B > C=D
+    ),
+    (
+        "mh_5_voters_4_candidates",
+        np.int32(2 * [[0, 1, 3, 2]] + [[1, 3, 2, 0]] + [[2, 3, 0, 1]] + [[2, 0, 3, 1]]),
+        np.int32([[0, 4, 4, 2], [1, 0, 3, 3], [1, 2, 0, 1], [3, 2, 4, 0]]),
+        np.int32([[0, 4, 4, 3], [3, 0, 3, 3], [0, 0, 0, 0], [3, 3, 4, 0]]),
+        np.int32([0, 1, 2, 0]),  # A=D > B > C
+    ),
+    (
+        "tbrc_2_voters_2_candidates",
+        np.int32([[0, 1], [1, 0]]),
+        np.int32([[0, 1], [1, 0]]),
+        np.int32([[0, 0], [0, 0]]),
+        np.int32([0, 0]),  # A=B
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,ballots,defeats,strengths,tied", ELECTOWIKI_CASES, ids=[c[0] for c in ELECTOWIKI_CASES]
+)
+def test_schulze_pipeline_stages(name, ballots, defeats, strengths, tied):
+    np.testing.assert_array_equal(compute_pairwise_defeats(ballots), defeats)
+    np.testing.assert_array_equal(compute_strongest_paths(defeats), strengths)
+    np.testing.assert_array_equal(rank_from_path_strengths(strengths), tied)
+    np.testing.assert_array_equal(schulze_social_ranking(ballots), tied)
+
+
+# (case index, seed, expected ranking after random tie-breaking)
+RANDOM_TIE_BREAK_CASES = [
+    (0, 0, np.int32([1, 3, 2, 0])),  # no ties: unchanged
+    (3, 1, np.int32([0, 2, 3, 1])),  # A=D tie broken -> A > D > B > C
+    (4, 0, np.int32([0, 1])),
+    (4, 3, np.int32([1, 0])),
+    (1, 1, np.int32([2, 0, 3, 1])),  # B=D > A=C -> D > B > A > C
+    (2, 2, np.int32([0, 1, 2, 3])),  # C=D broken -> A > B > C > D
+]
+
+
+@pytest.mark.parametrize("case_idx,seed,expected", RANDOM_TIE_BREAK_CASES)
+def test_aggregate_schulze_random_tie_breaking(case_idx, seed, expected):
+    ballots = ELECTOWIKI_CASES[case_idx][1]
+    agent_rankings = {f"agent_{i}": row for i, row in enumerate(ballots)}
+    result = aggregate_schulze(
+        agent_rankings, ballots.shape[1], seed=seed, tie_breaking_method="random"
+    )
+    assert result is not None
+    np.testing.assert_array_equal(result, expected)
+    if ballots.shape[1] > 1:
+        assert np.unique(result).size == result.size
+
+
+FIGURE_1_CASES = [
+    (
+        "figure1_opinion_round",
+        np.int32(
+            [[0, 1, 2, 3], [1, 0, 3, 2], [3, 0, 1, 2], [1, 2, 3, 0], [2, 1, 3, 0]]
+        ),
+        np.int32([2, 0, 3, 1]),  # B > D > A > C
+    ),
+    (
+        "figure1_critique_round",
+        np.int32(
+            [[2, 0, 1, 1], [0, 2, 1, 1], [2, 1, 1, 0], [1, 2, 0, 0], [3, 1, 0, 2]]
+        ),
+        np.int32([2, 1, 0, 0]),  # C=D > B > A
+    ),
+]
+
+
+@pytest.mark.parametrize("name,ballots,expected", FIGURE_1_CASES, ids=[c[0] for c in FIGURE_1_CASES])
+def test_schulze_figure1_rounds(name, ballots, expected):
+    np.testing.assert_array_equal(schulze_social_ranking(ballots), expected)
+
+
+@pytest.mark.parametrize(
+    "matrix",
+    [
+        np.int32([[0, 1, 1], [1, 1, 1], [1, 1, 0]]),  # non-zero diagonal
+        np.int32([[0, 1, 1], [1, 0, 1]]),  # non-square
+    ],
+)
+def test_schulze_invalid_matrices_raise(matrix):
+    with pytest.raises(ValueError):
+        compute_strongest_paths(matrix)
+    with pytest.raises(ValueError):
+        rank_from_path_strengths(matrix)
+
+
+def test_aggregate_schulze_drops_failed_agents_and_handles_empty():
+    ballots = ELECTOWIKI_CASES[0][1]
+    agent_rankings = {f"agent_{i}": row for i, row in enumerate(ballots)}
+    agent_rankings["failed"] = None
+    result = aggregate_schulze(agent_rankings, 4, seed=0)
+    np.testing.assert_array_equal(result, ELECTOWIKI_CASES[0][4])
+
+    assert aggregate_schulze({"a": None}, 4) is None
+    # Shape mismatch -> None
+    assert aggregate_schulze({"a": np.int32([0, 1])}, 4) is None
+
+
+# ---------------------------------------------------------------------------
+# Response / arrow-ranking parsing
+# ---------------------------------------------------------------------------
+
+
+def test_check_response_format():
+    assert check_response_format("<answer>Explanation\n<sep>\nA > B > C</answer>")
+    assert not check_response_format("Explanation\nA > B > C")
+
+
+@pytest.mark.parametrize(
+    "ranking_str,num_statements,expected",
+    [
+        ("A>B>C", 3, True),
+        ("A=B>C>D", 4, True),
+        ("A>B=C=D>E", 5, True),
+        ("A=B=C", 3, True),
+        ("A<B>C", 3, False),
+        ("A>>B>C", 3, False),
+        ("A>B>A", 3, False),
+        ("A>B=B>C", 3, False),
+        ("A>B>C>B", 4, False),
+        ("A>>B", 2, False),
+        ("A>B>>C", 3, False),
+        ("A=>B", 2, False),
+        ("A>B>", 2, False),
+        (">A>B", 2, False),
+        ("A=B=>C", 3, False),
+        ("A>B=", 2, False),
+        ("A=>B>C", 3, False),
+        ("A>C", 3, False),
+        ("A>B>C>D", 3, False),
+        ("", 0, False),
+    ],
+)
+def test_check_arrow_format(ranking_str, num_statements, expected):
+    assert check_arrow_format(ranking_str, num_statements) is expected
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("Explanation\nA > B > C", "A>B>C"),
+        ("Explanation\n  A  >  B  >  C", "A>B>C"),
+        ("Explanation\n  A  =  B  >  C", "A=B>C"),
+        ("Explanation\nA > B < C > D", "A>B"),
+        ("Explanation", None),
+    ],
+)
+def test_extract_arrow_ranking(text, expected):
+    assert extract_arrow_ranking(text) == expected
+
+
+@pytest.mark.parametrize(
+    "arrow,n,expected",
+    [
+        ("B>A=D>C", 4, [1, 0, 2, 1]),
+        ("A=B=C=D", 4, [0, 0, 0, 0]),
+        ("A", 1, [0]),
+        ("A>B", 3, None),  # missing C
+    ],
+)
+def test_parse_arrow_ranking(arrow, n, expected):
+    result = parse_arrow_ranking(arrow, n)
+    if expected is None:
+        assert result is None
+    else:
+        np.testing.assert_array_equal(result, np.array(expected))
+
+
+@pytest.mark.parametrize(
+    "response,n,expected_arr,expected_explanation",
+    [
+        (
+            "<answer>Explanation\n<sep>\nB>A=D>C</answer>",
+            4,
+            [1, 0, 2, 1],
+            "<answer>Explanation\n<sep>\nB>A=D>C</answer>",
+        ),
+        (
+            "Explanation\nB>A=D>C",
+            4,
+            None,
+            "INCORRECT_TEMPLATE: Explanation\nB>A=D>C",
+        ),
+        (
+            "<answer>Explanation\n<sep>\nB<A=D>C</answer>",
+            4,
+            None,
+            "INCORRECT_ARROW_RANKING: <answer>Explanation\n<sep>\nB<A=D>C</answer>",
+        ),
+        (
+            "Final ranking: B>A=D>C",
+            4,
+            [1, 0, 2, 1],
+            "Final ranking: B>A=D>C",
+        ),
+        (
+            "<answer>Explanation\n<sep>\nA=B=C=D</answer>",
+            4,
+            [0, 0, 0, 0],
+            "<answer>Explanation\n<sep>\nA=B=C=D</answer>",
+        ),
+        (
+            "<answer>Explanation\n<sep>\nB>A>B</answer>",
+            4,
+            None,
+            "INCORRECT_ARROW_RANKING: <answer>Explanation\n<sep>\nB>A>B</answer>",
+        ),
+        (
+            "<answer>Explanation\n<sep>\nA>C</answer>",
+            4,
+            None,
+            "INCORRECT_ARROW_RANKING: <answer>Explanation\n<sep>\nA>C</answer>",
+        ),
+    ],
+)
+def test_process_ranking_response(response, n, expected_arr, expected_explanation):
+    ranking, explanation = process_ranking_response(response, n)
+    if expected_arr is None:
+        assert ranking is None
+    else:
+        np.testing.assert_array_equal(ranking, np.array(expected_arr))
+    assert explanation == expected_explanation
+
+
+def test_extract_statement_envelope():
+    assert (
+        extract_statement("<answer>reasoning here\n<sep>\nWe should invest.</answer>")
+        == "We should invest."
+    )
+    # Truncated close tag tolerated
+    assert (
+        extract_statement("<answer>reasoning\n<sep>\nWe should invest more.")
+        == "We should invest more."
+    )
+    assert extract_statement("no envelope at all") is None
+    assert extract_statement("<answer>r<sep>tiny</answer>") is None  # <=5 chars
