@@ -1,0 +1,73 @@
+"""Pluggable model-execution backends.
+
+``get_backend("fake" | "tpu" | "api")`` resolves the generation/scoring
+engine used by all decoders — the single seam where the reference hard-wires
+its Together client (src/utils.py:69-74).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from consensus_tpu.backends.base import (  # noqa: F401
+    BAN_BIAS,
+    Backend,
+    GenerationRequest,
+    GenerationResult,
+    NextTokenRequest,
+    ScoreRequest,
+    ScoreResult,
+    TokenCandidate,
+    generate_one,
+    score_one,
+)
+from consensus_tpu.backends.fake import FakeBackend  # noqa: F401
+
+_BACKEND_CACHE: Dict[str, Backend] = {}
+
+
+def get_backend(spec: Optional[Any] = None, **kwargs) -> Backend:
+    """Resolve a backend from a name, config dict, or pass through an instance.
+
+    Accepted specs:
+      * ``None`` / ``"fake"``  -> :class:`FakeBackend`
+      * ``"tpu"``              -> :class:`~consensus_tpu.backends.tpu.TPUBackend`
+      * ``"api"``              -> :class:`~consensus_tpu.backends.api.APIBackend`
+      * ``{"name": ..., ...}`` -> as above with constructor kwargs
+      * an object already implementing :class:`Backend` -> returned unchanged
+    """
+    if spec is None:
+        spec = "fake"
+    if isinstance(spec, dict):
+        spec = dict(spec)
+        name = spec.pop("name", "fake")
+        kwargs = {**spec, **kwargs}
+    elif isinstance(spec, str):
+        name = spec
+    else:
+        return spec  # already a backend instance
+
+    cache_key = name if not kwargs else None
+    if cache_key and cache_key in _BACKEND_CACHE:
+        return _BACKEND_CACHE[cache_key]
+
+    if name == "fake":
+        backend: Backend = FakeBackend(**kwargs)
+    elif name == "tpu":
+        from consensus_tpu.backends.tpu import TPUBackend
+
+        backend = TPUBackend(**kwargs)
+    elif name == "api":
+        from consensus_tpu.backends.api import APIBackend
+
+        backend = APIBackend(**kwargs)
+    else:
+        raise ValueError(f"Unknown backend: {name!r}")
+
+    if cache_key:
+        _BACKEND_CACHE[cache_key] = backend
+    return backend
+
+
+def clear_backend_cache() -> None:
+    _BACKEND_CACHE.clear()

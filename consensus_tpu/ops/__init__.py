@@ -1,0 +1,8 @@
+from consensus_tpu.ops.welfare import (  # noqa: F401
+    WELFARE_RULES,
+    egalitarian_welfare,
+    log_nash_welfare,
+    sanitize_utilities,
+    utilitarian_welfare,
+    welfare,
+)

@@ -1,0 +1,73 @@
+"""Social-welfare reductions over (candidates, agents) utility tensors.
+
+The reference computes these with Python ``min``/``sum`` loops scattered
+across the decoders and evaluator (egalitarian: ``best_of_n.py:329-418``,
+``beam_search.py:557-560``; utilitarian & log-Nash: ``src/evaluation.py:
+274-394``; theory: ``core.py:108-114``).  Here they are jitted JAX reductions
+over the agent axis so decoders can fold them into on-device pipelines.
+
+Conventions (matching the reference):
+  * egalitarian  = min_i u_i       (max-min when argmaxed over candidates)
+  * utilitarian  = sum_i u_i
+  * log-Nash     = sum_i log(max(u_i, eps)), eps = 1e-9
+    (``src/evaluation.py:292-294``; only meaningful for positive utilities)
+
+``sanitize_utilities`` reproduces best_of_n's NaN/inf policy
+(``best_of_n.py:22-24, 380-389``): NaN -> default reward (-10), +inf -> +20,
+-inf -> -20.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+UTILITY_EPSILON = 1e-9
+DEFAULT_REWARD = -10.0
+REWARD_CLIP_MIN = -20.0
+REWARD_CLIP_MAX = 20.0
+
+
+@jax.jit
+def sanitize_utilities(utilities: jax.Array) -> jax.Array:
+    u = jnp.asarray(utilities, dtype=jnp.float32)
+    u = jnp.where(jnp.isnan(u), DEFAULT_REWARD, u)
+    u = jnp.where(jnp.isposinf(u), REWARD_CLIP_MAX, u)
+    u = jnp.where(jnp.isneginf(u), REWARD_CLIP_MIN, u)
+    return u
+
+
+@functools.partial(jax.jit, static_argnames=("axis",))
+def egalitarian_welfare(utilities: jax.Array, axis: int = -1) -> jax.Array:
+    return jnp.min(jnp.asarray(utilities), axis=axis)
+
+
+@functools.partial(jax.jit, static_argnames=("axis",))
+def utilitarian_welfare(utilities: jax.Array, axis: int = -1) -> jax.Array:
+    return jnp.sum(jnp.asarray(utilities), axis=axis)
+
+
+@functools.partial(jax.jit, static_argnames=("axis",))
+def log_nash_welfare(utilities: jax.Array, axis: int = -1) -> jax.Array:
+    u = jnp.maximum(jnp.asarray(utilities), UTILITY_EPSILON)
+    return jnp.sum(jnp.log(u), axis=axis)
+
+
+WELFARE_RULES = {
+    "egalitarian": egalitarian_welfare,
+    "utilitarian": utilitarian_welfare,
+    "log_nash": log_nash_welfare,
+}
+
+
+def welfare(utilities: jax.Array, rule: str = "egalitarian", axis: int = -1) -> jax.Array:
+    """Reduce a utility tensor along the agent axis with the named rule."""
+    try:
+        fn = WELFARE_RULES[rule]
+    except KeyError:
+        raise ValueError(
+            f"Unknown welfare rule: {rule!r}. Expected one of {sorted(WELFARE_RULES)}"
+        ) from None
+    return fn(utilities, axis=axis)

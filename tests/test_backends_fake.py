@@ -1,0 +1,124 @@
+"""FakeBackend determinism and protocol contract tests."""
+
+import numpy as np
+
+from consensus_tpu.backends import (
+    Backend,
+    FakeBackend,
+    GenerationRequest,
+    NextTokenRequest,
+    ScoreRequest,
+    get_backend,
+)
+
+
+def test_protocol_conformance():
+    assert isinstance(FakeBackend(), Backend)
+
+
+def test_get_backend_resolution():
+    backend = get_backend("fake")
+    assert backend.name == "fake"
+    assert get_backend("fake") is backend  # cached
+    assert get_backend(backend) is backend  # pass-through
+    assert get_backend({"name": "fake", "embed_dim": 16}).embed_dim == 16
+
+
+def test_generation_deterministic_and_seed_sensitive():
+    backend = FakeBackend()
+    req = GenerationRequest(user_prompt="Issue: transit", seed=1, max_tokens=30)
+    a = backend.generate([req])[0]
+    b = backend.generate([req])[0]
+    assert a.text == b.text and a.text
+    c = backend.generate([GenerationRequest(user_prompt="Issue: transit", seed=2)])[0]
+    assert c.text != a.text
+
+
+def test_generation_respects_stop_sequences():
+    backend = FakeBackend()
+    req = GenerationRequest(user_prompt="p", seed=0, stop=(".",))
+    text = backend.generate([req])[0].text
+    assert "." not in text
+
+
+def test_score_deterministic_and_context_sensitive():
+    backend = FakeBackend()
+    req = ScoreRequest(context="ctx A", continuation="the shared future")
+    r1, r2 = backend.score([req, req])
+    assert r1.logprobs == r2.logprobs
+    assert len(r1.tokens) == 3
+    assert all(-6.0 <= lp <= -0.05 for lp in r1.logprobs)
+    other = backend.score([ScoreRequest(context="ctx B", continuation="the shared future")])[0]
+    assert other.logprobs != r1.logprobs
+    assert r1.mean() != r1.total()
+    assert np.isclose(r1.total(), sum(r1.logprobs))
+
+
+def test_score_empty_continuation_uses_default():
+    backend = FakeBackend()
+    result = backend.score([ScoreRequest(context="c", continuation="")])[0]
+    assert not result.ok
+    assert result.mean() == -10.0
+    assert result.total(default=-3.0) == -3.0
+
+
+def test_next_token_topk_sorted_unique():
+    backend = FakeBackend()
+    req = NextTokenRequest(user_prompt="prompt", k=5, mode="topk")
+    cands = backend.next_token_logprobs([req])[0]
+    assert len(cands) == 5
+    lps = [c.logprob for c in cands]
+    assert lps == sorted(lps, reverse=True)
+    assert len({c.token for c in cands}) == 5
+
+
+def test_next_token_sampling_seeded_and_biased():
+    backend = FakeBackend()
+    a = backend.next_token_logprobs(
+        [NextTokenRequest(user_prompt="p", k=4, mode="sample", seed=0)]
+    )[0]
+    b = backend.next_token_logprobs(
+        [NextTokenRequest(user_prompt="p", k=4, mode="sample", seed=0)]
+    )[0]
+    assert [c.token for c in a] == [c.token for c in b]
+    # Banning ":"-like junk tokens keeps them out of the top-k.
+    banned = backend.next_token_logprobs(
+        [
+            NextTokenRequest(
+                user_prompt="p", k=10, mode="topk", bias_against_tokens=("<|eot_id|>", ",")
+            )
+        ]
+    )[0]
+    assert all("," not in c.token and "<|eot_id|>" not in c.token for c in banned)
+
+
+def test_instruction_following_ranking():
+    backend = FakeBackend()
+    prompt = (
+        "Use Arrow notation for the ranking.\n\nStatements to rank:\n"
+        "A. first statement\nB. second statement\nC. third statement\n"
+    )
+    text = backend.generate([GenerationRequest(user_prompt=prompt, seed=3)])[0].text
+    assert "<answer>" in text and "<sep>" in text and "</answer>" in text
+    from consensus_tpu.social_choice import process_ranking_response
+
+    ranking, _ = process_ranking_response(text, 3)
+    assert ranking is not None and set(ranking) == {0, 1, 2}
+
+
+def test_instruction_following_envelope():
+    backend = FakeBackend()
+    prompt = "Provide your answer in the following format:\n<answer>\n...\n<sep>\n..."
+    text = backend.generate([GenerationRequest(user_prompt=prompt, seed=3)])[0].text
+    from consensus_tpu.social_choice import extract_statement
+
+    assert extract_statement(text)
+
+
+def test_embeddings_unit_norm_deterministic():
+    backend = FakeBackend(embed_dim=32)
+    vecs = backend.embed(["alpha", "beta", "alpha"])
+    assert vecs.shape == (3, 32)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, atol=1e-9)
+    np.testing.assert_allclose(vecs[0], vecs[2])
+    assert not np.allclose(vecs[0], vecs[1])

@@ -1,0 +1,43 @@
+"""Welfare reduction tests (reference semantics: evaluation.py:274-394)."""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.ops import (
+    egalitarian_welfare,
+    log_nash_welfare,
+    sanitize_utilities,
+    utilitarian_welfare,
+    welfare,
+)
+
+U = np.array([[0.5, 0.2, 0.9], [0.1, 0.8, 0.3]])
+
+
+def test_egalitarian_is_min():
+    np.testing.assert_allclose(egalitarian_welfare(U), [0.2, 0.1])
+
+
+def test_utilitarian_is_sum():
+    np.testing.assert_allclose(utilitarian_welfare(U), [1.6, 1.2], rtol=1e-6)
+
+
+def test_log_nash_is_sum_of_logs_with_epsilon():
+    expected = np.log(U).sum(axis=1)
+    np.testing.assert_allclose(log_nash_welfare(U), expected, rtol=1e-5)
+    # zero utility clamps at epsilon instead of -inf
+    v = log_nash_welfare(np.array([[0.0, 0.5]]))
+    assert np.isfinite(v).all()
+    np.testing.assert_allclose(v, np.log(1e-9) + np.log(0.5), rtol=1e-5)
+
+
+def test_welfare_dispatch_and_axis():
+    np.testing.assert_allclose(welfare(U, "egalitarian", axis=0), U.min(axis=0))
+    with pytest.raises(ValueError):
+        welfare(U, "nash_product")
+
+
+def test_sanitize_matches_best_of_n_policy():
+    raw = np.array([np.nan, np.inf, -np.inf, 1.5])
+    out = np.asarray(sanitize_utilities(raw))
+    np.testing.assert_allclose(out, [-10.0, 20.0, -20.0, 1.5])
