@@ -1,0 +1,6 @@
+from consensus_tpu.models.config import ModelConfig, get_model_config  # noqa: F401
+from consensus_tpu.models.transformer import (  # noqa: F401
+    forward,
+    init_params,
+    make_cache,
+)

@@ -1,0 +1,125 @@
+"""Batched autoregressive generation with a preallocated KV cache.
+
+The decode loop is a ``lax.scan`` over step index — one compiled program per
+(batch, context, max_new_tokens) shape bucket.  Prompts must be LEFT-padded
+so every row's next token writes the same cache slot and the last prompt
+column is always a real token.
+
+Replaces the reference's per-call HTTPS text generation
+(``generate_text``, src/utils.py:77-198): temperature/seed/stop/logit-bias
+semantics live here and in :mod:`consensus_tpu.models.sampling`; stop-*string*
+truncation stays host-side in the backend (tokenizer-dependent).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consensus_tpu.models.config import ModelConfig
+from consensus_tpu.models.sampling import sample_tokens
+from consensus_tpu.models.transformer import forward, make_cache
+
+
+class GenerateOutput(NamedTuple):
+    tokens: jax.Array  # (B, max_new_tokens) int32; pad_id after EOS
+    num_generated: jax.Array  # (B,) int32 — tokens before (excluding) EOS
+    hit_eos: jax.Array  # (B,) bool
+
+
+def left_pad_positions(valid: jax.Array) -> jax.Array:
+    """RoPE positions for a left-padded valid mask: pads clamp to 0."""
+    return jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "max_new_tokens", "top_k", "top_p", "pad_id"),
+)
+def generate_tokens(
+    params,
+    config: ModelConfig,
+    prompt_tokens: jax.Array,  # (B, S_ctx) int32, LEFT-padded
+    prompt_valid: jax.Array,  # (B, S_ctx) bool
+    key: jax.Array,
+    max_new_tokens: int,
+    temperature: float | jax.Array = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_ids: Optional[jax.Array] = None,  # (E,) int32; None/empty = no EOS stop
+    logit_bias: Optional[jax.Array] = None,  # (V,) additive
+    pad_id: int = 0,
+) -> GenerateOutput:
+    batch, s_ctx = prompt_tokens.shape
+    if eos_ids is None:
+        eos_ids = jnp.zeros((0,), jnp.int32)
+
+    cache = make_cache(config, batch, s_ctx + max_new_tokens, params["embed"].dtype)
+    positions = left_pad_positions(prompt_valid)
+    logits, cache = forward(
+        params, config, prompt_tokens, positions, prompt_valid, cache, 0
+    )
+    next_logits = logits[:, -1, :]
+    cur_pos = positions[:, -1]
+
+    def is_eos(token: jax.Array) -> jax.Array:
+        if eos_ids.shape[0] == 0:
+            return jnp.zeros_like(token, dtype=jnp.bool_)
+        return jnp.any(token[:, None] == eos_ids[None, :], axis=-1)
+
+    def step(carry, i):
+        next_logits, cache, done, key, cur_pos = carry
+        key, sub = jax.random.split(key)
+        token = sample_tokens(
+            sub, next_logits, temperature=temperature, top_k=top_k, top_p=top_p,
+            logit_bias=logit_bias,
+        )
+        token = jnp.where(done, pad_id, token)
+        token_is_eos = is_eos(token) & ~done
+        emitted = ~done & ~token_is_eos  # counts toward generated text
+        new_done = done | token_is_eos
+
+        pos = cur_pos + 1
+        step_valid = ~done  # EOS token itself still enters the cache
+        logits, new_cache = forward(
+            params,
+            config,
+            token[:, None],
+            pos[:, None],
+            step_valid[:, None],
+            cache,
+            s_ctx + i,
+        )
+        carry = (logits[:, 0, :], new_cache, new_done, key, pos)
+        return carry, (token, emitted)
+
+    init = (next_logits, cache, jnp.zeros((batch,), jnp.bool_), key, cur_pos)
+    _, (tokens, emitted) = jax.lax.scan(init=init, f=step, xs=jnp.arange(max_new_tokens))
+
+    tokens = tokens.T  # (B, T)
+    emitted = emitted.T
+    num_generated = jnp.sum(emitted.astype(jnp.int32), axis=1)
+    hit_eos = num_generated < max_new_tokens
+    tokens = jnp.where(emitted, tokens, pad_id)
+    return GenerateOutput(tokens=tokens, num_generated=num_generated, hit_eos=hit_eos)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def next_token_logits(
+    params,
+    config: ModelConfig,
+    prompt_tokens: jax.Array,  # (B, S) LEFT-padded
+    prompt_valid: jax.Array,
+) -> jax.Array:
+    """Full next-token logit rows (B, V) — one forward, no cache.
+
+    The primitive behind ``Backend.next_token_logprobs``: the reference needed
+    up to ``max_sampling_attempts`` API calls to see k distinct next tokens
+    (beam_search.py:253-333); on device the whole distribution is free.
+    """
+    positions = left_pad_positions(prompt_valid)
+    logits, _ = forward(params, config, prompt_tokens, positions, prompt_valid)
+    return logits[:, -1, :]

@@ -1,0 +1,61 @@
+"""Token sampling: temperature / top-k / top-p / logit-bias, batched.
+
+Replaces the sampling surface the reference gets from the Together API
+(``temperature``, ``seed``, ``logit_bias``, ``stop`` params of
+src/utils.py:77-198).  Logit bias maps of {token_id: bias} become a dense
+additive vector so banning junk tokens (beam_search.py:38-56) is one add.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _top_k_filter(logits: jax.Array, k: int) -> jax.Array:
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    threshold = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def _top_p_filter(logits: jax.Array, p: float) -> jax.Array:
+    if p >= 1.0:  # static: top_p is a static argname of sample_tokens
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # Keep tokens until cumulative prob exceeds p (always keep the first).
+    keep_sorted = jnp.roll(cumulative < p, 1, axis=-1).at[..., 0].set(True)
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
+def sample_tokens(
+    key: jax.Array,
+    logits: jax.Array,  # (B, V) float32
+    temperature: float | jax.Array = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    logit_bias: Optional[jax.Array] = None,  # (V,) or (B, V) additive
+) -> jax.Array:
+    """Sample one token id per row; temperature<=0 means greedy argmax."""
+    logits = logits.astype(jnp.float32)
+    if logit_bias is not None:
+        logits = logits + logit_bias
+
+    greedy = jnp.argmax(logits, axis=-1)
+
+    filtered = _top_k_filter(logits, top_k)
+    filtered = _top_p_filter(filtered, top_p)
+    temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    sampled = jax.random.categorical(key, filtered / temp, axis=-1)
+
+    use_greedy = jnp.asarray(temperature, jnp.float32) <= 0.0
+    return jnp.where(use_greedy, greedy, sampled).astype(jnp.int32)

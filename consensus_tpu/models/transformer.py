@@ -1,0 +1,325 @@
+"""Pure-JAX decoder-only transformer runtime (Gemma-2 / Llama-3 families).
+
+This is the component the reference outsources to the Together API — there is
+no model-execution code anywhere in the reference (SURVEY §0); every decoder
+"forward pass" is an HTTPS call (src/utils.py:70).  Here the model is a
+functional program over a parameter pytree, designed TPU-first:
+
+* layers are *stacked* along a leading axis and executed with ``lax.scan`` —
+  one layer gets traced/compiled regardless of depth;
+* static shapes everywhere: prompts are left-padded into a fixed context
+  window for generation (so every decode step writes the same cache slot for
+  all rows) and right-padded for teacher-forced scoring;
+* grouped-query attention, RoPE, RMSNorm, GeGLU/SwiGLU, Gemma-2 logit
+  softcaps and alternating sliding-window layers;
+* a preallocated KV cache pytree threaded through ``forward`` so prefill and
+  decode share one code path.
+
+Everything here is shape-polymorphic in batch only; wrap calls in ``jax.jit``
+(the TPU backend does) and XLA sees a single static program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from consensus_tpu.models.config import ModelConfig
+
+Params = Dict[str, Any]
+
+MASK_FILL = -1e9  # finite fill: pad query rows softmax to uniform, not NaN
+
+
+# ---------------------------------------------------------------------------
+# Parameter initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    config: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.float32
+) -> Params:
+    """Random-normal params, stacked over layers on the leading axis."""
+    c = config
+    keys = jax.random.split(key, 8)
+
+    def dense(k, *shape, scale=None):
+        scale = scale if scale is not None else shape[-2] ** -0.5
+        return (jax.random.normal(k, shape) * scale).astype(dtype)
+
+    h, kv, hd = c.n_heads, c.n_kv_heads, c.head_dim
+    layers = {
+        "attn_norm": jnp.zeros((c.n_layers, c.d_model), dtype)
+        if c.rmsnorm_style == "gemma"
+        else jnp.ones((c.n_layers, c.d_model), dtype),
+        "wq": dense(keys[0], c.n_layers, c.d_model, h * hd),
+        "wk": dense(keys[1], c.n_layers, c.d_model, kv * hd),
+        "wv": dense(keys[2], c.n_layers, c.d_model, kv * hd),
+        "wo": dense(keys[3], c.n_layers, h * hd, c.d_model),
+        "ffn_norm": jnp.zeros((c.n_layers, c.d_model), dtype)
+        if c.rmsnorm_style == "gemma"
+        else jnp.ones((c.n_layers, c.d_model), dtype),
+        "w_gate": dense(keys[4], c.n_layers, c.d_model, c.ffn_hidden),
+        "w_up": dense(keys[5], c.n_layers, c.d_model, c.ffn_hidden),
+        "w_down": dense(keys[6], c.n_layers, c.ffn_hidden, c.d_model),
+    }
+    if c.use_post_norms:
+        zeros = jnp.zeros((c.n_layers, c.d_model), dtype)
+        ones = jnp.ones((c.n_layers, c.d_model), dtype)
+        layers["post_attn_norm"] = zeros if c.rmsnorm_style == "gemma" else ones
+        layers["post_ffn_norm"] = zeros if c.rmsnorm_style == "gemma" else ones
+
+    params: Params = {
+        "embed": (jax.random.normal(keys[7], (c.vocab_size, c.d_model)) * 0.02).astype(
+            dtype
+        ),
+        "layers": layers,
+        "final_norm": jnp.zeros((c.d_model,), dtype)
+        if c.rmsnorm_style == "gemma"
+        else jnp.ones((c.d_model,), dtype),
+    }
+    if not c.tie_lm_head:
+        params["lm_head"] = dense(
+            jax.random.fold_in(keys[7], 1), c.vocab_size, c.d_model, scale=c.d_model**-0.5
+        )
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float, style: str) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    scale = (1.0 + weight.astype(jnp.float32)) if style == "gemma" else weight.astype(
+        jnp.float32
+    )
+    return (normed * scale).astype(dtype)
+
+
+def _rope_angles(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    half = head_dim // 2
+    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freq  # (..., half)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotate (B, S, H, hd) by per-token positions (B, S). Half-split layout."""
+    cos, sin = _rope_angles(positions, x.shape[-1], theta)
+    cos = cos[:, :, None, :]  # (B, S, 1, half)
+    sin = sin[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
+    if cap is None:
+        return x
+    return cap * jnp.tanh(x / cap)
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array  # (L, B, T, KV, hd)
+    v: jax.Array  # (L, B, T, KV, hd)
+    key_positions: jax.Array  # (B, T) int32
+    key_valid: jax.Array  # (B, T) bool
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.key_positions, self.key_valid), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def make_cache(
+    config: ModelConfig, batch: int, max_len: int, dtype: jnp.dtype = jnp.float32
+) -> KVCache:
+    c = config
+    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        key_positions=jnp.zeros((batch, max_len), jnp.int32),
+        key_valid=jnp.zeros((batch, max_len), jnp.bool_),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward pass
+# ---------------------------------------------------------------------------
+
+
+def _attention_masks(
+    config: ModelConfig,
+    q_positions: jax.Array,  # (B, S)
+    q_valid: jax.Array,  # (B, S)
+    k_positions: jax.Array,  # (B, T)
+    k_valid: jax.Array,  # (B, T)
+) -> Tuple[jax.Array, jax.Array]:
+    """(global_mask, local_mask), each (B, 1, S, T) boolean."""
+    qp = q_positions[:, :, None]  # (B, S, 1)
+    kp = k_positions[:, None, :]  # (B, 1, T)
+    causal = (kp <= qp) & k_valid[:, None, :] & q_valid[:, :, None]
+    global_mask = causal[:, None, :, :]
+    if config.sliding_window is not None:
+        local = causal & (qp - kp < config.sliding_window)
+        local_mask = local[:, None, :, :]
+    else:
+        local_mask = global_mask
+    return global_mask, local_mask
+
+
+def forward(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (B, S) int32
+    positions: jax.Array,  # (B, S) int32 RoPE positions
+    valid: jax.Array,  # (B, S) bool — real (non-pad) tokens
+    cache: Optional[KVCache] = None,
+    write_index: int | jax.Array = 0,
+) -> Tuple[jax.Array, Optional[KVCache]]:
+    """Run the transformer. Returns (logits (B, S, V) float32, updated cache).
+
+    Without a cache, attention runs over this call's own keys (full
+    teacher-forced forward).  With a cache, this call's k/v are written at
+    ``write_index`` (same slot for every row — callers left-pad prompts) and
+    attention runs over the whole cache buffer.
+    """
+    c = config
+    x = params["embed"][tokens]
+    if c.scale_embeddings:
+        x = x * jnp.asarray(c.d_model**0.5, x.dtype)
+
+    if cache is None:
+        k_positions, k_valid = positions, valid
+    else:
+        span = tokens.shape[1]
+        k_positions = jax.lax.dynamic_update_slice(
+            cache.key_positions, positions, (0, write_index)
+        )
+        k_valid = jax.lax.dynamic_update_slice(cache.key_valid, valid, (0, write_index))
+
+    global_mask, local_mask = _attention_masks(c, positions, valid, k_positions, k_valid)
+    local_flags = jnp.asarray(c.local_flags)
+
+    h, kv, hd = c.n_heads, c.n_kv_heads, c.head_dim
+    batch, span = tokens.shape
+
+    def layer_step(x, scanned):
+        lp, k_cache_l, v_cache_l, is_local = scanned
+
+        attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
+        q = (attn_in @ lp["wq"]).reshape(batch, span, h, hd)
+        k = (attn_in @ lp["wk"]).reshape(batch, span, kv, hd)
+        v = (attn_in @ lp["wv"]).reshape(batch, span, kv, hd)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+
+        if k_cache_l is None:
+            keys, values = k, v
+        else:
+            keys = jax.lax.dynamic_update_slice(k_cache_l, k, (0, write_index, 0, 0))
+            values = jax.lax.dynamic_update_slice(v_cache_l, v, (0, write_index, 0, 0))
+
+        # GQA: repeat kv heads up to n_heads.
+        reps = h // kv
+        keys_r = jnp.repeat(keys, reps, axis=2)  # (B, T, H, hd)
+        values_r = jnp.repeat(values, reps, axis=2)
+
+        logits = jnp.einsum("bshd,bthd->bhst", q, keys_r).astype(jnp.float32)
+        logits = logits * c.q_scale
+        logits = _softcap(logits, c.attn_softcap)
+        mask = jnp.where(is_local, local_mask, global_mask)
+        logits = jnp.where(mask, logits, MASK_FILL)
+        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", weights, values_r)
+        attn = attn.reshape(batch, span, h * hd) @ lp["wo"]
+        if c.use_post_norms:
+            attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
+        x = x + attn
+
+        ffn_in = rms_norm(x, lp["ffn_norm"], c.rms_eps, c.rmsnorm_style)
+        gate = ffn_in @ lp["w_gate"]
+        if c.activation == "geglu":
+            gate = jax.nn.gelu(gate, approximate=True)
+        else:
+            gate = jax.nn.silu(gate)
+        ffn = (gate * (ffn_in @ lp["w_up"])) @ lp["w_down"]
+        if c.use_post_norms:
+            ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
+        x = x + ffn
+
+        return x, (keys if k_cache_l is not None else None,
+                   values if k_cache_l is not None else None)
+
+    layer_params = params["layers"]
+    if cache is None:
+        x, _ = jax.lax.scan(
+            lambda carry, xs: (
+                layer_step(carry, (xs[0], None, None, xs[1]))[0],
+                None,
+            ),
+            x,
+            (layer_params, local_flags),
+        )
+        new_cache = None
+    else:
+        def scan_fn(carry, xs):
+            lp, kc, vc, flag = xs
+            new_x, (nk, nv) = layer_step(carry, (lp, kc, vc, flag))
+            return new_x, (nk, nv)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_fn, x, (layer_params, cache.k, cache.v, local_flags)
+        )
+        new_cache = KVCache(k=new_k, v=new_v, key_positions=k_positions, key_valid=k_valid)
+
+    x = rms_norm(x, params["final_norm"], c.rms_eps, c.rmsnorm_style)
+    head = params["embed"] if c.tie_lm_head else params["lm_head"]
+    logits = jnp.einsum("bsd,vd->bsv", x, head).astype(jnp.float32)
+    logits = _softcap(logits, c.final_softcap)
+    return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Teacher-forced scoring
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def token_logprobs(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (B, S) right-padded
+    valid: jax.Array,  # (B, S)
+) -> jax.Array:
+    """Per-position logprob of tokens[:, t] given tokens[:, :t].
+
+    Returns (B, S) float32; position 0 gets 0.0 (no conditioning context).
+    This is the on-device replacement for the reference's echo'd-prompt
+    logprob extraction (src/utils.py:201-373): one forward, gather.
+    """
+    positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+    logits, _ = forward(params, config, tokens, positions, valid)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    gathered = jnp.take_along_axis(
+        logprobs[:, :-1, :], tokens[:, 1:, None], axis=-1
+    )[..., 0]
+    return jnp.pad(gathered, ((0, 0), (1, 0)))
