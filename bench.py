@@ -1,0 +1,93 @@
+"""Benchmark: best-of-N consensus-statement throughput on device.
+
+Reproduces the shape of the reference's headline workload (BASELINE.json:
+"Statements/sec (Gemma-2B, 5-agent, N=32)"): generate N=32 candidate
+statements (50 new tokens each) from a reference prompt, then score every
+(candidate x agent) pair teacher-forced and pick the egalitarian-welfare
+argmax — the exact pipeline the reference runs as ~200 sequential HTTPS
+calls per statement (best_of_n.py flow, SURVEY §2.3), here as two batched
+device programs.
+
+Baseline: the reference's measured best-of-N wall clock on the Together API
+is 61-77 s/statement (BASELINE.md, generation-cost table) -> ~1/70 st/s.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+N_CANDIDATES = 32
+N_AGENTS = 5
+NEW_TOKENS = 50
+CTX_LEN = 256  # prompt context budget (issue + opinions)
+SCORE_LEN = 320  # agent context + candidate, right-padded
+BASELINE_STATEMENTS_PER_SEC = 1.0 / 70.0
+TIMED_ROUNDS = 3
+
+
+def main() -> None:
+    from consensus_tpu.models.config import get_model_config
+    from consensus_tpu.models.generate import generate_tokens
+    from consensus_tpu.models.transformer import init_params, token_logprobs_streamed
+    from consensus_tpu.ops.welfare import egalitarian_welfare, sanitize_utilities
+
+    config = get_model_config("gemma2-2b")
+    params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+
+    key = jax.random.PRNGKey(42)
+    prompt = jax.random.randint(key, (N_CANDIDATES, CTX_LEN), 0, config.vocab_size, jnp.int32)
+    prompt_valid = jnp.ones((N_CANDIDATES, CTX_LEN), jnp.bool_)
+    score_tokens = jax.random.randint(
+        jax.random.fold_in(key, 1),
+        (N_CANDIDATES * N_AGENTS, SCORE_LEN),
+        0,
+        config.vocab_size,
+        jnp.int32,
+    )
+    score_valid = jnp.ones((N_CANDIDATES * N_AGENTS, SCORE_LEN), jnp.bool_)
+
+    def one_statement(step_key):
+        out = generate_tokens(
+            params, config, prompt, prompt_valid, step_key,
+            max_new_tokens=NEW_TOKENS, temperature=1.0, top_k=64,
+        )
+        lp = token_logprobs_streamed(params, config, score_tokens, score_valid)
+        utilities = lp.sum(axis=1).reshape(N_CANDIDATES, N_AGENTS) / SCORE_LEN
+        welfare = egalitarian_welfare(sanitize_utilities(utilities), axis=1)
+        return out.tokens, jnp.argmax(welfare)
+
+    import numpy as np
+
+    # Warmup / compile.  NOTE: fetch to host, not block_until_ready — on the
+    # tunneled (axon relay) TPU block_until_ready returns before remote
+    # execution finishes, which silently fakes the timing.
+    tokens, best = one_statement(jax.random.PRNGKey(7))
+    _ = np.asarray(tokens), int(best)
+
+    start = time.perf_counter()
+    for i in range(TIMED_ROUNDS):
+        tokens, best = one_statement(jax.random.PRNGKey(100 + i))
+        _ = np.asarray(tokens), int(best)  # host transfer forces completion
+    elapsed = time.perf_counter() - start
+
+    statements_per_sec = TIMED_ROUNDS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "best_of_n_statements_per_sec",
+                "value": round(statements_per_sec, 4),
+                "unit": "statements/sec (Gemma-2B, 5-agent, N=32, 50 tok)",
+                "vs_baseline": round(statements_per_sec / BASELINE_STATEMENTS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

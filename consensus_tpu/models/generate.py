@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from consensus_tpu.models.config import ModelConfig
 from consensus_tpu.models.sampling import sample_tokens
-from consensus_tpu.models.transformer import forward, make_cache
+from consensus_tpu.models.transformer import forward, make_cache, project_logits
 
 
 class GenerateOutput(NamedTuple):
@@ -59,10 +59,13 @@ def generate_tokens(
 
     cache = make_cache(config, batch, s_ctx + max_new_tokens, params["embed"].dtype)
     positions = left_pad_positions(prompt_valid)
-    logits, cache = forward(
-        params, config, prompt_tokens, positions, prompt_valid, cache, 0
+    # Prefill: take hidden states and project ONLY the last position — a full
+    # (B, S_ctx, 256k) logits tensor would blow HBM on production vocabs.
+    hidden, cache = forward(
+        params, config, prompt_tokens, positions, prompt_valid, cache, 0,
+        return_hidden=True,
     )
-    next_logits = logits[:, -1, :]
+    next_logits = project_logits(params, config, hidden[:, -1, :])
     cur_pos = positions[:, -1]
 
     def is_eos(token: jax.Array) -> jax.Array:
@@ -121,5 +124,7 @@ def next_token_logits(
     (beam_search.py:253-333); on device the whole distribution is free.
     """
     positions = left_pad_positions(prompt_valid)
-    logits, _ = forward(params, config, prompt_tokens, positions, prompt_valid)
-    return logits[:, -1, :]
+    hidden, _ = forward(
+        params, config, prompt_tokens, positions, prompt_valid, return_hidden=True
+    )
+    return project_logits(params, config, hidden[:, -1, :])

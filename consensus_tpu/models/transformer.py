@@ -194,6 +194,7 @@ def forward(
     valid: jax.Array,  # (B, S) bool — real (non-pad) tokens
     cache: Optional[KVCache] = None,
     write_index: int | jax.Array = 0,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Run the transformer. Returns (logits (B, S, V) float32, updated cache).
 
@@ -201,6 +202,10 @@ def forward(
     teacher-forced forward).  With a cache, this call's k/v are written at
     ``write_index`` (same slot for every row — callers left-pad prompts) and
     attention runs over the whole cache buffer.
+
+    ``return_hidden=True`` returns the final-norm hidden states (B, S, D)
+    instead of logits — used by the streaming scorer, which must never
+    materialize a full (B, S, V) logits tensor for 256k-vocab models.
     """
     c = config
     x = params["embed"][tokens]
@@ -292,15 +297,25 @@ def forward(
         new_cache = KVCache(k=new_k, v=new_v, key_positions=k_positions, key_valid=k_valid)
 
     x = rms_norm(x, params["final_norm"], c.rms_eps, c.rmsnorm_style)
-    head = params["embed"] if c.tie_lm_head else params["lm_head"]
-    logits = jnp.einsum("bsd,vd->bsv", x, head).astype(jnp.float32)
-    logits = _softcap(logits, c.final_softcap)
-    return logits, new_cache
+    if return_hidden:
+        return x, new_cache
+    return project_logits(params, c, x), new_cache
 
 
 # ---------------------------------------------------------------------------
 # Teacher-forced scoring
 # ---------------------------------------------------------------------------
+
+
+def project_logits(params: Params, config: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """Head-project hidden states (..., D) -> float32 logits (..., V), with
+    the model's final softcap.  Callers slice hidden down (e.g. to the last
+    position) BEFORE projecting so a (B, S, 256k) tensor never materializes."""
+    head = params["embed"] if config.tie_lm_head else params["lm_head"]
+    logits = jnp.einsum(
+        "...d,vd->...v", hidden, head, preferred_element_type=jnp.float32
+    )
+    return _softcap(logits, config.final_softcap)
 
 
 @functools.partial(jax.jit, static_argnames=("config",))
@@ -315,6 +330,9 @@ def token_logprobs(
     Returns (B, S) float32; position 0 gets 0.0 (no conditioning context).
     This is the on-device replacement for the reference's echo'd-prompt
     logprob extraction (src/utils.py:201-373): one forward, gather.
+
+    Materializes the full (B, S, V) logits — fine for small vocabs/tests;
+    use :func:`token_logprobs_streamed` for 256k-vocab production models.
     """
     positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
     logits, _ = forward(params, config, tokens, positions, valid)
@@ -322,4 +340,70 @@ def token_logprobs(
     gathered = jnp.take_along_axis(
         logprobs[:, :-1, :], tokens[:, 1:, None], axis=-1
     )[..., 0]
+    return jnp.pad(gathered, ((0, 0), (1, 0)))
+
+
+@functools.partial(jax.jit, static_argnames=("config", "vocab_chunk"))
+def token_logprobs_streamed(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (B, S) right-padded
+    valid: jax.Array,  # (B, S)
+    vocab_chunk: int = 8192,
+) -> jax.Array:
+    """Memory-bounded teacher-forced scoring for huge vocabularies.
+
+    A (B, S, 256k) float32 logits tensor for a Gemma-2 scoring batch is tens
+    of GB — over HBM.  Instead: one forward to final hidden states, then a
+    ``lax.scan`` over vocab tiles maintaining a streaming logsumexp
+    (running max + rescaled sum), plus a direct gather of the target-token
+    logits.  Peak extra memory is one (B, S, vocab_chunk) tile.  Gemma-2's
+    final logit softcap (tanh) is applied per-tile, so semantics match
+    :func:`token_logprobs` exactly.
+    """
+    c = config
+    positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+    x, _ = forward(params, c, tokens, positions, valid, return_hidden=True)
+    head = params["embed"] if c.tie_lm_head else params["lm_head"]  # (V, D)
+    vocab = head.shape[0]
+    n_chunks = -(-vocab // vocab_chunk)
+    batch, span = tokens.shape
+
+    def tile_step(carry, i):
+        run_max, run_sum = carry  # (B, S) fp32 each
+        # Clamp the final tile's start instead of padding `head` — padding
+        # would materialize a full copy of the 256k-row embedding in HBM.
+        # Rows a clamped tile re-reads are masked out below.
+        start = jnp.maximum(jnp.minimum(i * vocab_chunk, vocab - vocab_chunk), 0)
+        rows = jax.lax.dynamic_slice(
+            head, (start, jnp.int32(0)), (min(vocab_chunk, vocab), head.shape[1])
+        )
+        tile = jnp.einsum(
+            "bsd,vd->bsv", x, rows, preferred_element_type=jnp.float32
+        )
+        tile = _softcap(tile, c.final_softcap)
+        row_ids = start + jnp.arange(rows.shape[0])
+        fresh = (row_ids >= i * vocab_chunk) & (row_ids < vocab)
+        tile = jnp.where(fresh[None, None, :], tile, -jnp.inf)
+        tile_max = jnp.max(tile, axis=-1)
+        new_max = jnp.maximum(run_max, tile_max)
+        run_sum = run_sum * jnp.exp(run_max - new_max) + jnp.sum(
+            jnp.exp(tile - new_max[..., None]), axis=-1
+        )
+        return (new_max, run_sum), None
+
+    init = (
+        jnp.full((batch, span), -jnp.inf, jnp.float32),
+        jnp.zeros((batch, span), jnp.float32),
+    )
+    (run_max, run_sum), _ = jax.lax.scan(tile_step, init, jnp.arange(n_chunks))
+    lse = run_max + jnp.log(run_sum)  # (B, S)
+
+    # Target logits: gather the next token's head row, dot with hidden.
+    target_rows = head[tokens[:, 1:], :]  # (B, S-1, D)
+    target_logits = jnp.einsum(
+        "bsd,bsd->bs", x[:, :-1, :], target_rows, preferred_element_type=jnp.float32
+    )
+    target_logits = _softcap(target_logits, c.final_softcap)
+    gathered = target_logits - lse[:, :-1]
     return jnp.pad(gathered, ((0, 0), (1, 0)))

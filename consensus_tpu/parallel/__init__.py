@@ -1,0 +1,30 @@
+"""SPMD parallelism: device meshes, sharding specs, sharded step functions.
+
+The reference has **no** distributed compute of any kind — its "parallelism"
+is a thread pool around HTTP calls (src/experiment.py:283-322; SURVEY §2.16).
+This package is the TPU-native replacement: a `jax.sharding.Mesh` over ICI
+with data-parallel batch axes and tensor-parallel model axes, XLA inserting
+the collectives.
+"""
+
+from consensus_tpu.parallel.mesh import (
+    MeshPlan,
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+    shard_batch,
+    shard_params,
+)
+from consensus_tpu.parallel.train import train_step, init_train_state, lm_loss
+
+__all__ = [
+    "MeshPlan",
+    "batch_sharding",
+    "make_mesh",
+    "param_shardings",
+    "shard_batch",
+    "shard_params",
+    "train_step",
+    "init_train_state",
+    "lm_loss",
+]

@@ -1,0 +1,126 @@
+"""Device mesh + sharding layout for the transformer runtime.
+
+Layout philosophy (scaling-book recipe: pick a mesh, annotate shardings, let
+XLA insert collectives):
+
+* Mesh axes ``("data", "model")``.  The experiment workload — (seeds ×
+  scenarios × candidates × agents) forward passes — is embarrassingly
+  data-parallel, so ``data`` is the large axis; ``model`` carries tensor
+  parallelism for models that don't fit (or aren't fast enough) per chip.
+* Tensor-parallel params follow the Megatron layout expressed as
+  PartitionSpecs: attention q/k/v projections and the FFN up/gate split
+  their *output* features over ``model``; the o-projection and FFN down
+  split their *input* features, so each layer needs exactly one psum
+  (XLA inserts it from the shardings).
+* The embedding shards its vocab rows over ``model``; logits come out
+  sharded over vocab and argmax/softmax reductions ride ICI collectives.
+
+The reference has no counterpart to any of this — its concurrency is a
+thread pool over HTTP calls (src/experiment.py:283-322).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A mesh plus the axis sizes it was built with."""
+
+    mesh: Mesh
+    dp: int
+    tp: int
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.tp
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    tp: int = 1,
+    dp: Optional[int] = None,
+) -> MeshPlan:
+    """Build a ``(data, model)`` mesh over the given (default: all) devices.
+
+    ``tp`` is the tensor-parallel degree; remaining devices become data
+    parallel.  ``tp=1`` (pure DP, model replicated) is the right default for
+    the 2B/9B models of the reference workload (SURVEY §5.8).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % tp != 0:
+        raise ValueError(f"tp={tp} does not divide device count {n}")
+    dp = dp if dp is not None else n // tp
+    if dp * tp != n:
+        raise ValueError(f"dp*tp = {dp * tp} != device count {n}")
+    grid = np.array(devices).reshape(dp, tp)
+    return MeshPlan(mesh=Mesh(grid, (DATA_AXIS, MODEL_AXIS)), dp=dp, tp=tp)
+
+
+#: PartitionSpec per parameter leaf. Layer-stacked leaves carry a leading
+#: layer axis (never sharded — it is scanned over).
+_LAYER_SPECS: Dict[str, P] = {
+    "attn_norm": P(None, None),
+    "ffn_norm": P(None, None),
+    "post_attn_norm": P(None, None),
+    "post_ffn_norm": P(None, None),
+    # (L, D, H*hd): split heads (output features) over model.
+    "wq": P(None, None, MODEL_AXIS),
+    "wk": P(None, None, MODEL_AXIS),
+    "wv": P(None, None, MODEL_AXIS),
+    # (L, H*hd, D): split input features — contraction psum follows.
+    "wo": P(None, MODEL_AXIS, None),
+    # (L, D, F): split hidden features.
+    "w_gate": P(None, None, MODEL_AXIS),
+    "w_up": P(None, None, MODEL_AXIS),
+    # (L, F, D): split input features.
+    "w_down": P(None, MODEL_AXIS, None),
+}
+
+_TOP_SPECS: Dict[str, P] = {
+    # (V, D): shard vocab rows.
+    "embed": P(MODEL_AXIS, None),
+    "lm_head": P(MODEL_AXIS, None),
+    "final_norm": P(None),
+}
+
+
+def param_shardings(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """NamedSharding pytree matching a runtime param pytree."""
+
+    def top(name: str, value):
+        if name == "layers":
+            return {
+                k: NamedSharding(mesh, _LAYER_SPECS.get(k, P()))
+                for k in value
+            }
+        return NamedSharding(mesh, _TOP_SPECS.get(name, P()))
+
+    return {name: top(name, value) for name, value in params.items()}
+
+
+def shard_params(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """Place a param pytree on the mesh with the TP layout."""
+    return jax.device_put(params, param_shardings(params, mesh))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (B, S) token/mask arrays: batch over ``data``."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def shard_batch(mesh: Mesh, *arrays: jax.Array):
+    """Place batch-leading arrays on the mesh, sharded over ``data``."""
+    sharding = batch_sharding(mesh)
+    placed = tuple(jax.device_put(a, sharding) for a in arrays)
+    return placed[0] if len(placed) == 1 else placed

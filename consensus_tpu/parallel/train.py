@@ -1,0 +1,72 @@
+"""Sharded training step for the on-device model runtime.
+
+The reference never trains anything (SURVEY §0: "no training"), but the
+framework's model runtime is a full functional transformer, so fine-tuning
+the policy model on-device (e.g. adapting the reference policy to a
+deliberation domain) is a natural capability — and it is the program the
+driver's multichip dry-run exercises: teacher-forced LM loss, ``jax.grad``,
+optax update, all jitted over a ``(data, model)`` mesh so XLA lays gradients'
+psums over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from consensus_tpu.models.config import ModelConfig
+from consensus_tpu.models.transformer import forward
+
+Params = Dict[str, Any]
+
+
+def lm_loss(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (B, S) int32, right-padded
+    valid: jax.Array,  # (B, S) bool
+) -> jax.Array:
+    """Mean next-token cross-entropy over valid target positions."""
+    positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+    logits, _ = forward(params, config, tokens, positions, valid)
+    logprobs = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
+    targets = tokens[:, 1:]
+    target_lp = jnp.take_along_axis(logprobs, targets[:, :, None], axis=-1)[..., 0]
+    mask = (valid[:, :-1] & valid[:, 1:]).astype(jnp.float32)
+    return -(target_lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_optimizer(learning_rate: float = 1e-4) -> optax.GradientTransformation:
+    return optax.adamw(learning_rate)
+
+
+def init_train_state(
+    params: Params, learning_rate: float = 1e-4
+) -> Tuple[Params, optax.OptState, optax.GradientTransformation]:
+    opt = make_optimizer(learning_rate)
+    return params, opt.init(params), opt
+
+
+# Note: no buffer donation — optax.init's zero moments can alias identical
+# constant buffers, and donating aliased leaves is an XLA error.
+@functools.partial(jax.jit, static_argnames=("config", "optimizer"))
+def train_step(
+    params: Params,
+    opt_state: optax.OptState,
+    config: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    tokens: jax.Array,
+    valid: jax.Array,
+) -> Tuple[Params, optax.OptState, jax.Array]:
+    """One SGD step. Sharding comes from the input placement: params laid
+    out by :func:`consensus_tpu.parallel.mesh.shard_params`, batch by
+    :func:`shard_batch`; XLA propagates and inserts the ICI collectives
+    (gradient psum over ``data``, activation psums over ``model``)."""
+    loss, grads = jax.value_and_grad(lm_loss)(params, config, tokens, valid)
+    updates, new_opt_state = optimizer.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    return new_params, new_opt_state, loss

@@ -1,8 +1,13 @@
 """Test harness configuration.
 
 Forces JAX onto the host CPU platform with 8 virtual devices so every
-sharding/mesh test runs mesh-shape-faithfully without TPU hardware.  Must run
-before the first ``import jax`` anywhere in the test session.
+sharding/mesh test runs mesh-shape-faithfully without TPU hardware.
+
+Note: this environment's sitecustomize registers an ``axon`` TPU PJRT
+plugin and force-sets ``jax_platforms="axon,cpu"`` via ``jax.config.update``
+at interpreter startup — so the env var alone is not enough; we must update
+the config back to ``cpu`` after importing jax (backend init is lazy, so
+this is safe as long as it happens before the first device lookup).
 """
 
 import os
@@ -13,3 +18,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

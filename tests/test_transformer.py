@@ -278,3 +278,22 @@ def test_byte_tokenizer_chat_and_bias():
     assert tok.raw_prompt("u", "s") == "s\n\nu"
     ids = tok.token_ids_containing(":")
     assert all(":" in tok.token_str(i) for i in ids)
+
+
+def test_streamed_scoring_matches_naive():
+    """token_logprobs_streamed == token_logprobs on a non-chunk-aligned vocab."""
+    from consensus_tpu.models.config import get_model_config
+    from consensus_tpu.models.transformer import (
+        init_params,
+        token_logprobs,
+        token_logprobs_streamed,
+    )
+
+    config = get_model_config("tiny-gemma2", vocab_size=500, n_layers=2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 12), 0, 500, jnp.int32)
+    valid = jnp.arange(12)[None, :] < jnp.array([12, 9, 5])[:, None]
+
+    naive = token_logprobs(params, config, tokens, valid)
+    streamed = token_logprobs_streamed(params, config, tokens, valid, vocab_chunk=128)
+    np.testing.assert_allclose(np.asarray(streamed), np.asarray(naive), atol=1e-4)
