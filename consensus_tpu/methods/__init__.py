@@ -1,0 +1,55 @@
+"""Decoding methods: the L3 layer (SURVEY §1).
+
+``GENERATOR_MAP`` / ``get_method_generator`` mirror the reference factory
+(src/methods/__init__.py:11-44) with one signature change: a Backend is
+passed explicitly instead of a module-global client.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Type
+
+from consensus_tpu.backends.base import Backend
+from consensus_tpu.methods.base import BaseGenerator
+from consensus_tpu.methods.best_of_n import BestOfNGenerator
+from consensus_tpu.methods.habermas import HabermasMachineGenerator
+from consensus_tpu.methods.predefined import PredefinedStatementGenerator
+from consensus_tpu.methods.zero_shot import ZeroShotGenerator
+
+GENERATOR_MAP: Dict[str, Type[BaseGenerator]] = {
+    "zero_shot": ZeroShotGenerator,
+    "best_of_n": BestOfNGenerator,
+    "habermas_machine": HabermasMachineGenerator,
+    "predefined": PredefinedStatementGenerator,
+}
+
+
+def register_generator(name: str, cls: Type[BaseGenerator]) -> None:
+    GENERATOR_MAP[name] = cls
+
+
+def get_method_generator(
+    method_name: str,
+    backend: Backend,
+    config: Optional[Dict[str, Any]] = None,
+    model_identifier: str = "",
+) -> BaseGenerator:
+    """Instantiate the named method (reference src/methods/__init__.py:22-44)."""
+    try:
+        cls = GENERATOR_MAP[method_name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown method: {method_name!r}. Available: {sorted(GENERATOR_MAP)}"
+        ) from None
+    return cls(backend=backend, config=config, model_identifier=model_identifier)
+
+
+__all__ = [
+    "BaseGenerator",
+    "BestOfNGenerator",
+    "GENERATOR_MAP",
+    "PredefinedStatementGenerator",
+    "ZeroShotGenerator",
+    "get_method_generator",
+    "register_generator",
+]

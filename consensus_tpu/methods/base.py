@@ -1,0 +1,54 @@
+"""Decoder base class + factory.
+
+Reference counterpart: ``src/methods/base.py`` (BaseGenerator ABC) and
+``src/methods/__init__.py`` (GENERATOR_MAP / get_method_generator).  The one
+architectural change: generators receive an explicit :class:`Backend`
+instead of reaching for a module-global HTTP client (src/utils.py:69-74) —
+the seam that lets the same decoder logic run against the TPU runtime, the
+fake test backend, or a remote API.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Optional
+
+from consensus_tpu.backends.base import Backend
+
+
+class BaseGenerator(abc.ABC):
+    """A consensus-statement decoding method.
+
+    Parameters
+    ----------
+    backend:
+        Model-execution backend (TPU / fake / API).
+    config:
+        The method's section of the experiment YAML (seed already injected
+        by the experiment engine).
+    model_identifier:
+        Carried for result keys and API-backend routing; the TPU backend
+        ignores it (its model is fixed at construction).
+    """
+
+    def __init__(
+        self,
+        backend: Backend,
+        config: Optional[Dict[str, Any]] = None,
+        model_identifier: str = "",
+    ):
+        self.backend = backend
+        self.config = dict(config or {})
+        self.model_identifier = model_identifier
+        # Statement before the optional brushup pass; the experiment engine
+        # records it when present (reference src/experiment.py:184-188).
+        self.pre_brushup_statement: Optional[str] = None
+
+    @abc.abstractmethod
+    def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
+        """Produce one consensus statement for the issue and opinions."""
+
+    @property
+    def seed(self) -> Optional[int]:
+        value = self.config.get("seed")
+        return int(value) if value is not None else None

@@ -1,0 +1,111 @@
+"""Best-of-N: sequence-level egalitarian search, fully batched.
+
+Reference: ``src/methods/best_of_n.py`` (SURVEY §2.3).  Same semantics —
+generate N full candidates from the reference prompt with seeds
+``seed + i``, score every (candidate × agent) pair as the mean logprob of
+the candidate under the agent-conditioned policy, sanitize, take the
+max-min (egalitarian) candidate — but the reference's ~N + N×A sequential
+API calls become exactly TWO backend calls: one batched ``generate`` and
+one batched ``score`` whose (N × A) requests a device backend executes as
+a single padded forward.
+
+Scoring layout parity (reference best_of_n.py:282-293): the agent context
+(system + opinion prompt) conditions, the candidate text is the scored
+continuation; utility = mean over candidate-token logprobs, default −10.0
+on failure (:22,314).  Welfare: min across agents with NaN→−10 / ±inf→±20
+sanitization (:23-24,380-389).  ``beta`` is accepted-but-unused, as in the
+reference (SURVEY §7.4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from consensus_tpu.backends.base import GenerationRequest, ScoreRequest
+from consensus_tpu.methods.base import BaseGenerator
+from consensus_tpu.methods.prompts import agent_prompt, clean_statement, reference_prompt
+from consensus_tpu.ops.welfare import (
+    DEFAULT_REWARD,
+    egalitarian_welfare,
+    sanitize_utilities,
+)
+
+
+class BestOfNGenerator(BaseGenerator):
+    def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
+        cfg = self.config
+        # Config key ``num_best_of_n`` preferred over ``n`` (reference :60-62).
+        n = int(cfg.get("num_best_of_n", cfg.get("n", 3)))
+        max_tokens = int(cfg.get("max_tokens", 50))
+        temperature = float(cfg.get("temperature", 1.0))
+        seed = self.seed
+
+        candidates = self._generate_candidates(
+            issue, agent_opinions, n, max_tokens, temperature, seed
+        )
+        if not candidates:
+            return "[ERROR: Failed to generate any candidates]"
+
+        utilities = self.score_candidates(issue, agent_opinions, candidates)
+        welfare = egalitarian_welfare(sanitize_utilities(utilities), axis=1)
+        best = int(np.argmax(np.asarray(welfare)))
+        return candidates[best]
+
+    # -- steps ---------------------------------------------------------------
+
+    def _generate_candidates(
+        self,
+        issue: str,
+        agent_opinions: Dict[str, str],
+        n: int,
+        max_tokens: int,
+        temperature: float,
+        seed,
+    ) -> List[str]:
+        system, user = reference_prompt(issue, agent_opinions)
+        requests = [
+            GenerationRequest(
+                user_prompt=user,
+                system_prompt=system,
+                max_tokens=max_tokens,
+                temperature=temperature,
+                seed=(seed + i) if seed is not None else None,
+                chat=True,
+            )
+            for i in range(n)
+        ]
+        results = self.backend.generate(requests)
+        candidates = []
+        for result in results:
+            if not result.ok:
+                continue
+            cleaned = clean_statement(result.text)
+            if cleaned:
+                candidates.append(cleaned)
+        return candidates
+
+    def score_candidates(
+        self, issue: str, agent_opinions: Dict[str, str], candidates: List[str]
+    ) -> np.ndarray:
+        """(num_candidates, num_agents) mean-logprob utility matrix — ONE
+        batched score call over the flattened (candidate × agent) grid."""
+        agents = list(agent_opinions.items())
+        requests = []
+        for candidate in candidates:
+            for _, opinion in agents:
+                system, user = agent_prompt(issue, opinion)
+                requests.append(
+                    ScoreRequest(
+                        context=user,
+                        continuation=candidate,
+                        system_prompt=system,
+                        chat=True,
+                    )
+                )
+        results = self.backend.score(requests)
+        means = [r.mean(default=DEFAULT_REWARD) for r in results]
+        return np.asarray(means, dtype=np.float32).reshape(
+            len(candidates), len(agents)
+        )

@@ -1,0 +1,337 @@
+"""Habermas Machine: text-level generate → rank → Schulze → critique → revise.
+
+Reference: ``src/methods/habermas_machine.py`` (1.5k LoC; SURVEY §2.7), the
+DeepMind Habermas-Machine-style deliberation loop:
+
+1. draft ``num_candidates`` candidate statements (CoT ``<answer>…<sep>…</answer>``
+   envelope, reference :440-477);
+2. predict each agent's preference ranking over the candidates in Arrow
+   notation at temperature 0 with seeded retries (reference :586-654, 921-982);
+3. aggregate rankings with the Schulze method + seeded random-ballot
+   tie-breaking (reference :985-1260 — here
+   :mod:`consensus_tpu.social_choice.schulze`);
+4. for each of ``num_rounds``: per-agent critiques of the winner
+   (reference :1263-1341), ``min(num_candidates, 4)`` revised statements
+   conditioned on opinions + winner + critiques with fallback to the previous
+   winner (reference :1344-1499), re-rank, re-aggregate.
+
+Batch-first redesign: every phase issues ONE backend call over its whole
+request set (candidates / agents / revisions) instead of the reference's
+sequential per-item API calls — on the TPU backend a phase is a single
+padded generation batch.
+
+Seed scheme: the reference threads an elaborate additive-offset choreography
+through phases (:91-95, 220-331).  We keep the *property* that matters —
+every (phase, round, item, retry) gets a distinct deterministic seed — via
+structured offsets from the base seed (documented in ``_phase_seed``).
+Results are self-consistent but not bitwise-comparable to API runs
+(SURVEY §7.1).
+
+Config keys (reference :40-60): ``num_candidates`` (3), ``num_rounds`` (1),
+``num_retries_on_error`` (1) — note the reference *reads* this key while its
+configs set ``num_retries``, so retries silently default there (SURVEY §7.4);
+we read the same key the reference code reads.  ``tie_breaking_method``
+("random"), ``max_tokens`` (700 for CoT envelopes), ``seed``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from consensus_tpu.backends.base import GenerationRequest
+from consensus_tpu.methods.base import BaseGenerator
+from consensus_tpu.social_choice.parsing import (
+    extract_statement,
+    process_ranking_response,
+)
+from consensus_tpu.social_choice.schulze import aggregate_schulze
+
+_PHASE_OFFSETS = {"candidates": 0, "ranking": 1, "critique": 2, "revision": 3}
+
+ENVELOPE_FORMAT = (
+    "Answer in exactly this format:\n<answer>\n[your step-by-step reasoning]\n"
+    "<sep>\n[{payload}]\n</answer>"
+)
+
+
+def _draft_prompt(issue: str, opinions: List[str]) -> str:
+    numbered = "\n".join(
+        f"Opinion Person {i + 1}: {op}" for i, op in enumerate(opinions)
+    )
+    return (
+        "You are helping a citizens' jury reach consensus on a question. "
+        "Draft a consensus statement that captures the jury's shared view and "
+        "conflicts with none of the individual opinions. Think step by step: "
+        "identify common themes across the opinions, then write a statement "
+        "of less than 50 tokens reflecting them.\n\n"
+        + ENVELOPE_FORMAT.format(payload="draft consensus statement")
+        + f"\n\nQuestion: {issue}\n\nIndividual Opinions:\n{numbered}"
+    )
+
+
+def _ranking_prompt(issue: str, opinion: str, statements: List[str]) -> str:
+    labeled = "\n".join(
+        f"{chr(ord('A') + i)}. {s.strip().strip(chr(34)).strip()}"
+        for i, s in enumerate(statements)
+    )
+    return (
+        "Rank the statements below by how strongly this participant would "
+        "agree with each, judging ONLY from their stated opinion. Give the "
+        "final ranking in Arrow notation, using '>' for strict preference "
+        "(ties are NOT allowed), e.g. 'B > A > C'. Think step by step, "
+        "comparing each statement against the opinion, before ranking.\n\n"
+        + ENVELOPE_FORMAT.format(payload="final ranking in Arrow notation")
+        + f"\n\nQuestion: {issue}\n\nParticipant's Opinion: {opinion}\n\n"
+        f"Statements to rank:\n{labeled}\n\nProvide your answer:"
+    )
+
+
+def _critique_prompt(issue: str, opinion: str, statement: str) -> str:
+    return (
+        "You are a deliberation participant. Critique the proposed consensus "
+        "statement using ONLY your stated opinion: say what it captures, what "
+        "it contradicts, and what it omits from your perspective. Think step "
+        "by step before writing the critique.\n\n"
+        + ENVELOPE_FORMAT.format(payload="your critique of the statement")
+        + f"\n\nQuestion: {issue}\n\nYour Opinion: {opinion}\n\n"
+        f"Proposed Consensus Statement: {statement}"
+    )
+
+
+def _revision_prompt(
+    issue: str,
+    opinions: List[str],
+    winner: str,
+    critiques: List[Optional[str]],
+) -> str:
+    numbered_ops = "\n".join(
+        f"Opinion Person {i + 1}: {op}" for i, op in enumerate(opinions)
+    )
+    numbered_crit = "\n".join(
+        f"Critique Person {i + 1}: {c}" for i, c in enumerate(critiques) if c
+    )
+    return (
+        "You are helping a citizens' jury revise a draft consensus statement. "
+        "Using the individual opinions, the previous draft, and the jury's "
+        "critiques, write a revised consensus statement of less than 50 "
+        "tokens that addresses the critiques and conflicts with no opinion. "
+        "Think step by step before writing it.\n\n"
+        + ENVELOPE_FORMAT.format(payload="revised consensus statement")
+        + f"\n\nQuestion: {issue}\n\nIndividual Opinions:\n{numbered_ops}\n\n"
+        f"Previous Draft Consensus Statement: {winner}\n\n"
+        f"Critiques of the Previous Draft:\n{numbered_crit}"
+    )
+
+
+class HabermasMachineGenerator(BaseGenerator):
+    def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
+        cfg = self.config
+        num_candidates = int(cfg.get("num_candidates", 3))
+        num_rounds = int(cfg.get("num_rounds", 1))
+        self._num_retries = int(cfg.get("num_retries_on_error", 1))
+        self._tie_breaking = cfg.get("tie_breaking_method", "random")
+        self._max_tokens = int(cfg.get("max_tokens", 700))
+
+        opinions = list(agent_opinions.values())
+
+        # Instance state inspectable post-hoc (reference :136-140, 201, 425).
+        self.candidate_statements: List[str] = []
+        self.agent_rankings: Dict[str, Optional[np.ndarray]] = {}
+        self.all_round_data: List[Dict] = []
+
+        # Phase 1: draft candidates.
+        candidates = self._draft_candidates(issue, opinions, num_candidates)
+        if not candidates:
+            return "[ERROR: Habermas Machine failed to generate candidates]"
+        self.candidate_statements = candidates
+
+        # Phase 2+3: rank + aggregate.
+        rankings = self._rank_all(issue, agent_opinions, candidates, round_num=0)
+        self.agent_rankings = rankings
+        winner = self._winner(candidates, rankings, round_num=0)
+        if winner is None:
+            return candidates[0]
+
+        # Phase 4: critique/revise rounds.
+        for round_num in range(num_rounds):
+            round_data: Dict = {"round": round_num + 1, "winner_before": winner}
+            critiques = self._critiques(issue, agent_opinions, winner, round_num)
+            round_data["agent_critiques"] = dict(zip(agent_opinions, critiques))
+            if not any(critiques):
+                self.all_round_data.append(round_data)
+                break
+
+            revised = self._revisions(
+                issue, opinions, winner, critiques,
+                n=min(num_candidates, 4), round_num=round_num,
+            )
+            if not revised:
+                self.all_round_data.append(round_data)
+                break
+            round_data["revised_statements"] = revised
+
+            rankings = self._rank_all(
+                issue, agent_opinions, revised, round_num=round_num + 1
+            )
+            round_data["agent_rankings"] = {
+                k: (v.tolist() if v is not None else None)
+                for k, v in rankings.items()
+            }
+            new_winner = self._winner(revised, rankings, round_num=round_num + 1)
+            if new_winner is not None:
+                winner = new_winner
+                self.candidate_statements = revised
+                self.agent_rankings = rankings
+            round_data["winner_after"] = winner
+            self.all_round_data.append(round_data)
+
+        return winner
+
+    # -- seeds ---------------------------------------------------------------
+
+    def _phase_seed(
+        self, phase: str, round_num: int, item: int, attempt: int = 0
+    ) -> Optional[int]:
+        """Distinct deterministic seed per (phase, round, item, retry)."""
+        if self.seed is None:
+            return None
+        return (
+            self.seed
+            + 100_000 * _PHASE_OFFSETS[phase]
+            + 10_000 * round_num
+            + 100 * attempt
+            + item
+        )
+
+    # -- phases --------------------------------------------------------------
+
+    def _generate_batch(
+        self, prompts: List[str], seeds: List[Optional[int]], temperature: float
+    ) -> List[str]:
+        requests = [
+            GenerationRequest(
+                user_prompt=prompt,
+                max_tokens=self._max_tokens,
+                temperature=temperature,
+                seed=seed,
+                chat=True,
+            )
+            for prompt, seed in zip(prompts, seeds)
+        ]
+        return [r.text if r.ok else "" for r in self.backend.generate(requests)]
+
+    def _draft_candidates(
+        self, issue: str, opinions: List[str], n: int
+    ) -> List[str]:
+        prompt = _draft_prompt(issue, opinions)
+        statements: List[str] = []
+        for attempt in range(self._num_retries + 1):
+            missing = n - len(statements)
+            if missing <= 0:
+                break
+            seeds = [
+                self._phase_seed("candidates", 0, i, attempt) for i in range(missing)
+            ]
+            responses = self._generate_batch([prompt] * missing, seeds, 1.0)
+            for response in responses:
+                parsed = extract_statement(response)
+                if parsed:
+                    statements.append(parsed)
+        return statements[:n]
+
+    def _rank_all(
+        self,
+        issue: str,
+        agent_opinions: Dict[str, str],
+        statements: List[str],
+        round_num: int,
+    ) -> Dict[str, Optional[np.ndarray]]:
+        """Predict every agent's ranking; temperature 0 (reference :948),
+        batched first attempt + batched retries for the failures."""
+        agents = list(agent_opinions.items())
+        rankings: Dict[str, Optional[np.ndarray]] = {name: None for name, _ in agents}
+        pending = list(range(len(agents)))
+        for attempt in range(self._num_retries + 1):
+            if not pending:
+                break
+            prompts = [
+                _ranking_prompt(issue, agents[i][1], statements) for i in pending
+            ]
+            seeds = [
+                self._phase_seed("ranking", round_num, i, attempt) for i in pending
+            ]
+            responses = self._generate_batch(prompts, seeds, 0.0)
+            still = []
+            for i, response in zip(pending, responses):
+                ranking, _explanation = process_ranking_response(
+                    response, len(statements)
+                )
+                if ranking is not None:
+                    rankings[agents[i][0]] = ranking
+                else:
+                    still.append(i)
+            pending = still
+        return rankings
+
+    def _winner(
+        self,
+        statements: List[str],
+        rankings: Dict[str, Optional[np.ndarray]],
+        round_num: int,
+    ) -> Optional[str]:
+        social = aggregate_schulze(
+            rankings,
+            num_candidates=len(statements),
+            seed=self._phase_seed("ranking", round_num, 99),
+            tie_breaking_method=self._tie_breaking,
+        )
+        if social is None:
+            return None
+        return statements[int(np.argmin(social))]
+
+    def _critiques(
+        self,
+        issue: str,
+        agent_opinions: Dict[str, str],
+        winner: str,
+        round_num: int,
+    ) -> List[Optional[str]]:
+        prompts = [
+            _critique_prompt(issue, opinion, winner)
+            for opinion in agent_opinions.values()
+        ]
+        seeds = [
+            self._phase_seed("critique", round_num, i)
+            for i in range(len(prompts))
+        ]
+        responses = self._generate_batch(prompts, seeds, 1.0)
+        return [extract_statement(r) for r in responses]
+
+    def _revisions(
+        self,
+        issue: str,
+        opinions: List[str],
+        winner: str,
+        critiques: List[Optional[str]],
+        n: int,
+        round_num: int,
+    ) -> List[str]:
+        """Revised candidates; failed generations fall back to the previous
+        winner (reference :1476-1482)."""
+        prompt = _revision_prompt(issue, opinions, winner, critiques)
+        revised: List[str] = []
+        for attempt in range(self._num_retries + 1):
+            missing = n - len(revised)
+            if missing <= 0:
+                break
+            seeds = [
+                self._phase_seed("revision", round_num, i, attempt)
+                for i in range(missing)
+            ]
+            responses = self._generate_batch([prompt] * missing, seeds, 1.0)
+            revised.extend(p for p in map(extract_statement, responses) if p)
+        while len(revised) < n:
+            revised.append(winner)
+        return revised[:n]

@@ -1,0 +1,104 @@
+"""Shared prompt templates for the consensus decoders.
+
+The reference embeds near-identical template constants in every decoder
+(best_of_n.py:29-35, beam_search.py:58-80, finite_lookahead.py:20-34,
+mcts.py:55-77); here they live once.  The *structure* is the semantics the
+welfare numbers depend on (SURVEY §7.3 "chat-template parity"): a reference
+policy conditioned on the issue + ALL opinions, and per-agent policies
+conditioned on the issue + ONE opinion, both instructed to write only a
+short statement.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+REFERENCE_SYSTEM_PROMPT = (
+    "You are generating a consensus statement that represents the views of "
+    "multiple participants.\nYour task is to continue the statement in a way "
+    "that addresses the issue and considers all participants' opinions. Be "
+    "concise and keep the statement short (less than 50 tokens) and focused. "
+    "ONLY WRITE THE STATEMENT AND NOTHING ELSE."
+)
+
+AGENT_SYSTEM_PROMPT = (
+    "You are generating a statement that represents the views of a single "
+    "participant.\nYour task is to continue the statement in a way that "
+    "addresses the issue and considers ONLY this participant's opinion. Be "
+    "concise and keep the statement short (less than 50 tokens) and focused. "
+    "ONLY WRITE THE STATEMENT AND NOTHING ELSE."
+)
+
+REFERENCE_USER_TEMPLATE = (
+    "Issue: {issue}\n\nParticipants' opinions:\n{opinions_text}\n\n"
+    "Consensus statement (less than 50 tokens): "
+)
+
+AGENT_USER_TEMPLATE = (
+    "Issue: {issue}\n\nAgent's opinion:\n{opinion}\n\n"
+    "Statement reflecting this opinion (less than 50 tokens): "
+)
+
+
+def format_opinions(agent_opinions: Dict[str, str]) -> str:
+    """Render the opinions block: one ``- Name: opinion`` line per agent."""
+    return "\n".join(f"- {name}: {opinion}" for name, opinion in agent_opinions.items())
+
+
+def reference_prompt(issue: str, agent_opinions: Dict[str, str]) -> Tuple[str, str]:
+    """(system, user) prompts for the all-opinions reference policy."""
+    return (
+        REFERENCE_SYSTEM_PROMPT,
+        REFERENCE_USER_TEMPLATE.format(
+            issue=issue, opinions_text=format_opinions(agent_opinions)
+        ),
+    )
+
+
+def agent_prompt(issue: str, opinion: str) -> Tuple[str, str]:
+    """(system, user) prompts for a single-opinion agent policy."""
+    return (
+        AGENT_SYSTEM_PROMPT,
+        AGENT_USER_TEMPLATE.format(issue=issue, opinion=opinion),
+    )
+
+
+#: Instruction-prefix strings models prepend despite being told not to;
+#: stripped from generations (reference best_of_n.py:216-229).
+STATEMENT_PREFIXES = (
+    "Consensus statement:",
+    "Statement:",
+    "Here is the consensus statement:",
+    "Here is a statement reflecting this opinion:",
+    "Okay, here is the statement:",
+)
+
+#: EOS marker strings that can leak into decoded text
+#: (reference best_of_n.py:26, beam_search.py:26-35).
+EOS_MARKERS = (
+    "<|eot_id|>",
+    "<|end_of_text|>",
+    "<end_of_turn>",
+    "<eos>",
+)
+
+
+def clean_statement(text: str) -> str:
+    """Strip instruction prefixes and trailing EOS markers from a generation
+    (behaviour of reference best_of_n.py:209-238)."""
+    if not text:
+        return ""
+    cleaned = text.strip()
+    lowered = cleaned.lower()
+    for prefix in STATEMENT_PREFIXES:
+        if lowered.startswith(prefix.lower()):
+            cleaned = cleaned[len(prefix):].strip()
+            break
+    changed = True
+    while changed:
+        changed = False
+        for eos in EOS_MARKERS:
+            if cleaned.endswith(eos):
+                cleaned = cleaned[: -len(eos)].strip()
+                changed = True
+    return cleaned

@@ -1,0 +1,113 @@
+"""Tests for zero_shot / predefined / best_of_n on the deterministic fake
+backend — the decoder-logic coverage the reference never had (SURVEY §4:
+"No mocks / fake backends for the LLM")."""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.methods import GENERATOR_MAP, get_method_generator
+from consensus_tpu.methods.prompts import clean_statement
+
+ISSUE = "Should the city invest in more bike lanes?"
+OPINIONS = {
+    "Agent 1": "Bike lanes make streets safer and should be expanded.",
+    "Agent 2": "Road space is scarce; cars and buses need priority.",
+    "Agent 3": "Invest only where cycling demand is proven.",
+}
+
+
+@pytest.fixture()
+def backend():
+    return FakeBackend()
+
+
+def test_factory_unknown_method_raises(backend):
+    with pytest.raises(ValueError, match="Unknown method"):
+        get_method_generator("definitely_not_a_method", backend)
+
+
+def test_factory_known_methods(backend):
+    for name in GENERATOR_MAP:
+        gen = get_method_generator(name, backend, {"seed": 1})
+        assert gen.backend is backend
+
+
+class TestCleanStatement:
+    def test_strips_prefix(self):
+        assert clean_statement("Statement: We agree.") == "We agree."
+        assert (
+            clean_statement("Here is the consensus statement: We agree.")
+            == "We agree."
+        )
+
+    def test_strips_eos_markers(self):
+        assert clean_statement("We agree.<|eot_id|>") == "We agree."
+        assert clean_statement("We agree.<end_of_turn><eos>") == "We agree."
+
+    def test_empty(self):
+        assert clean_statement("") == ""
+        assert clean_statement("   ") == ""
+
+
+class TestZeroShot:
+    def test_generates_real_statement(self, backend):
+        gen = get_method_generator("zero_shot", backend, {"seed": 42, "max_tokens": 30})
+        statement = gen.generate_statement(ISSUE, OPINIONS)
+        assert statement and "Placeholder" not in statement
+        assert backend.call_counts["generate"] == 1
+
+    def test_deterministic_in_seed(self, backend):
+        gen = get_method_generator("zero_shot", backend, {"seed": 42})
+        s1 = gen.generate_statement(ISSUE, OPINIONS)
+        s2 = gen.generate_statement(ISSUE, OPINIONS)
+        assert s1 == s2
+        gen2 = get_method_generator("zero_shot", backend, {"seed": 43})
+        assert gen2.generate_statement(ISSUE, OPINIONS) != s1
+
+
+class TestPredefined:
+    def test_returns_configured_statement(self, backend):
+        gen = get_method_generator(
+            "predefined", backend, {"predefined_statement": "Exactly this."}
+        )
+        assert gen.generate_statement(ISSUE, OPINIONS) == "Exactly this."
+        assert backend.call_counts["generate"] == 0
+
+    def test_missing_statement_error_sentinel(self, backend):
+        gen = get_method_generator("predefined", backend, {})
+        assert gen.generate_statement(ISSUE, OPINIONS).startswith("[ERROR")
+
+
+class TestBestOfN:
+    def test_two_backend_calls_total(self, backend):
+        gen = get_method_generator(
+            "best_of_n", backend, {"num_best_of_n": 5, "seed": 7}
+        )
+        statement = gen.generate_statement(ISSUE, OPINIONS)
+        assert statement
+        # 5 generation requests in ONE call; 5x3 score requests in ONE call.
+        assert backend.call_counts["generate"] == 5
+        assert backend.call_counts["score"] == 15
+
+    def test_picks_egalitarian_argmax(self, backend):
+        gen = get_method_generator("best_of_n", backend, {"n": 4, "seed": 3})
+        statement = gen.generate_statement(ISSUE, OPINIONS)
+
+        # Recompute expected winner from the same deterministic backend.
+        candidates = gen._generate_candidates(ISSUE, OPINIONS, 4, 50, 1.0, 3)
+        utilities = gen.score_candidates(ISSUE, OPINIONS, candidates)
+        assert utilities.shape == (len(candidates), 3)
+        expected = candidates[int(np.argmin(-utilities.min(axis=1)))]
+        assert statement == expected
+
+    def test_utilities_are_mean_logprobs(self, backend):
+        gen = get_method_generator("best_of_n", backend, {"seed": 0})
+        utilities = gen.score_candidates(ISSUE, OPINIONS, ["We support change."])
+        assert utilities.shape == (1, 3)
+        assert np.all(utilities <= 0.0) and np.all(utilities > -7.0)
+
+    def test_seed_variation_changes_candidates(self, backend):
+        gen = get_method_generator("best_of_n", backend, {"n": 3, "seed": 11})
+        c1 = gen._generate_candidates(ISSUE, OPINIONS, 3, 50, 1.0, 11)
+        assert len(set(c1)) == 3  # distinct seeds -> distinct candidates
