@@ -11,14 +11,21 @@ from typing import Any, Dict, Optional, Type
 
 from consensus_tpu.backends.base import Backend
 from consensus_tpu.methods.base import BaseGenerator
+from consensus_tpu.methods.beam_search import BeamSearchGenerator
 from consensus_tpu.methods.best_of_n import BestOfNGenerator
+from consensus_tpu.methods.finite_lookahead import FiniteLookaheadGenerator
 from consensus_tpu.methods.habermas import HabermasMachineGenerator
+from consensus_tpu.methods.mcts import MCTSGenerator
 from consensus_tpu.methods.predefined import PredefinedStatementGenerator
 from consensus_tpu.methods.zero_shot import ZeroShotGenerator
 
+#: Name → class map (reference src/methods/__init__.py:11-19).
 GENERATOR_MAP: Dict[str, Type[BaseGenerator]] = {
-    "zero_shot": ZeroShotGenerator,
+    "mcts": MCTSGenerator,
+    "beam_search": BeamSearchGenerator,
+    "finite_lookahead": FiniteLookaheadGenerator,
     "best_of_n": BestOfNGenerator,
+    "zero_shot": ZeroShotGenerator,
     "habermas_machine": HabermasMachineGenerator,
     "predefined": PredefinedStatementGenerator,
 }
@@ -46,8 +53,12 @@ def get_method_generator(
 
 __all__ = [
     "BaseGenerator",
+    "BeamSearchGenerator",
     "BestOfNGenerator",
+    "FiniteLookaheadGenerator",
     "GENERATOR_MAP",
+    "HabermasMachineGenerator",
+    "MCTSGenerator",
     "PredefinedStatementGenerator",
     "ZeroShotGenerator",
     "get_method_generator",

@@ -1,0 +1,172 @@
+"""Finite-lookahead (receding-horizon) token decoder, batched per tree level.
+
+Reference: ``src/methods/finite_lookahead.py`` (536 LoC; SURVEY §2.5).
+Semantics preserved:
+
+* outer loop emits ONE token per iteration up to ``max_tokens``
+  (reference :99-153);
+* each iteration grows a ``branching_factor``-ary lookahead tree of depth
+  ``max_depth`` from the reference policy continuing the current statement
+  (reference :225-422); terminator tokens end a path early (:350-355);
+  duplicate paths are dropped (:402-414);
+* each distinct path is scored per agent as the MEAN logprob of the path's
+  tokens under the agent-conditioned policy (reference :502-520 — the
+  documented reference-policy/KL subtraction is commented out there, and the
+  selection is max-min, not the Nash welfare its docstring claims;
+  SURVEY §7.4 says replicate the actual semantics, so: plain mean logprob,
+  egalitarian argmax);
+* only the best path's FIRST token is appended (:530-536); emission stops
+  when that token is a terminator.
+
+Cost redesign: the reference walks the tree with one 1-token API call per
+node and one scoring call per (path, agent) — 944–2 096 s per statement
+measured (SURVEY §6).  Here each tree LEVEL is one batched
+``next_token_logprobs`` call (every frontier node expanded at once, exact
+k-distinct sampling) and all (path × agent) scores are one batched ``score``
+call.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from consensus_tpu.backends.base import NextTokenRequest, ScoreRequest
+from consensus_tpu.methods.base import BaseGenerator
+from consensus_tpu.methods.beam_search import BIAS_AGAINST_TOKENS
+from consensus_tpu.methods.brushup import brushup_statement_ending
+from consensus_tpu.methods.prompts import agent_prompt, reference_prompt
+
+#: Tokens that terminate a lookahead path / the whole statement
+#: (reference finite_lookahead.py:141-144, 350-355).
+TERMINATOR_TOKENS = frozenset(
+    {"DONE", "\n", "\n\n", ".\n\n", "<|eot_id|>", "<|end_of_text|>",
+     "<end_of_turn>", "<eos>"}
+)
+
+DEFAULT_FAILURE_REWARD = -10.0
+
+
+class FiniteLookaheadGenerator(BaseGenerator):
+    def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
+        cfg = self.config
+        branching = int(cfg.get("branching_factor", 2))
+        max_depth = int(cfg.get("max_depth", 3))
+        max_tokens = int(cfg.get("max_tokens", 50))
+        temperature = float(cfg.get("temperature", 1.0))
+        seed = self.seed
+
+        agents = list(agent_opinions.items())
+        if not agents:
+            return ""
+
+        statement = ""
+        for step in range(max_tokens):
+            paths = self._tree_paths(
+                issue, agent_opinions, statement, branching, max_depth,
+                temperature,
+                seed=(seed + step) if seed is not None else None,
+            )
+            if not paths:
+                break
+            first_token = self._best_first_token(issue, agents, statement, paths)
+            if first_token is None:
+                break
+            if first_token in TERMINATOR_TOKENS:
+                break
+            statement += first_token
+
+        statement = statement.strip()
+        self.pre_brushup_statement = statement
+        if cfg.get("brushup", False):
+            statement = brushup_statement_ending(self.backend, statement, seed=seed)
+        return statement
+
+    # -- tree ----------------------------------------------------------------
+
+    def _tree_paths(
+        self,
+        issue: str,
+        agent_opinions: Dict[str, str],
+        statement: str,
+        branching: int,
+        max_depth: int,
+        temperature: float,
+        seed,
+    ) -> List[List[str]]:
+        """Grow the lookahead tree level by level — one batched call per
+        level over the whole frontier — and return deduplicated token paths."""
+        system, user = reference_prompt(issue, agent_opinions)
+        frontier: List[List[str]] = [[]]  # token paths still growing
+        finished: List[List[str]] = []
+
+        for depth in range(max_depth):
+            if not frontier:
+                break
+            requests = [
+                NextTokenRequest(
+                    user_prompt=user + statement + "".join(path),
+                    system_prompt=system,
+                    k=branching,
+                    temperature=temperature,
+                    seed=(seed * 1000 + depth * 100 + i)
+                    if seed is not None
+                    else None,
+                    mode="sample",
+                    bias_against_tokens=BIAS_AGAINST_TOKENS,
+                    chat=False,
+                )
+                for i, path in enumerate(frontier)
+            ]
+            proposals = self.backend.next_token_logprobs(requests)
+            next_frontier: List[List[str]] = []
+            for path, candidates in zip(frontier, proposals):
+                for candidate in candidates:
+                    extended = path + [candidate.token]
+                    if candidate.token in TERMINATOR_TOKENS:
+                        finished.append(extended)
+                    else:
+                        next_frontier.append(extended)
+            frontier = next_frontier
+
+        all_paths = finished + frontier
+        deduped: List[List[str]] = []
+        seen = set()
+        for path in all_paths:
+            key = "".join(path)
+            if key and key not in seen:
+                seen.add(key)
+                deduped.append(path)
+        return deduped
+
+    def _best_first_token(
+        self,
+        issue: str,
+        agents: List[Tuple[str, str]],
+        statement: str,
+        paths: List[List[str]],
+    ):
+        """Score all (path × agent) pairs in one batched call; return the
+        first token of the max-min path (reference :424-536)."""
+        requests = []
+        for path in paths:
+            for _, opinion in agents:
+                a_system, a_user = agent_prompt(issue, opinion)
+                requests.append(
+                    ScoreRequest(
+                        context=a_user + statement,
+                        continuation="".join(path),
+                        system_prompt=a_system,
+                        chat=False,
+                    )
+                )
+        results = self.backend.score(requests)
+
+        n_agents = len(agents)
+        best_path, best_welfare = None, None
+        for i, path in enumerate(paths):
+            scores = results[i * n_agents : (i + 1) * n_agents]
+            utilities = [s.mean(default=DEFAULT_FAILURE_REWARD) for s in scores]
+            welfare = min(utilities)
+            if best_welfare is None or welfare > best_welfare:
+                best_welfare, best_path = welfare, path
+        return best_path[0] if best_path else None

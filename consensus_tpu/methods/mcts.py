@@ -1,0 +1,247 @@
+"""Monte-Carlo tree search decoder (UCB1 + rollouts), batched per phase.
+
+Reference: ``src/methods/mcts.py`` (1 044 LoC; SURVEY §2.6/§3.4).  Search
+semantics preserved:
+
+* per emitted token, run ``num_simulations`` of select → expand/evaluate →
+  backpropagate, then advance the root to its most-visited child and detach
+  the parent (reference :920-1006);
+* selection walks UCB1 ``value + C·sqrt(ln(N_parent)/N)`` with unvisited
+  children preferred (reference :378-467);
+* expansion samples up to ``expansion_sample_width`` distinct next tokens,
+  pops one untried token per simulation; a child's immediate reward is the
+  egalitarian ``min`` over agents of the new token's logprob under the
+  agent-conditioned policy (reference :653-837);
+* non-terminal children also get a rollout — ``rollout_depth`` tokens
+  continued from the reference policy — valued as the ``min`` over agents of
+  the rolled-out statement's total logprob, combined as
+  ``reward = immediate + gamma * rollout`` (reference :470-651, 802);
+* failures score ``-100.0`` (reference :519,590,645,775).
+
+**Bug fixed, not replicated** (SURVEY §2.6/§7.4): the reference's rollout
+evaluation raises ``NameError`` on a stale f-string variable (mcts.py:614-616)
+and aborts every MCTS run; this implementation evaluates rollouts correctly.
+
+Cost redesign: expansion token proposal is one exact ``next_token_logprobs``
+call instead of a rejection-sampling loop (reference :165-247), and each
+evaluation batches all agents into one ``score`` call.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from consensus_tpu.backends.base import (
+    GenerationRequest,
+    NextTokenRequest,
+    ScoreRequest,
+)
+from consensus_tpu.methods.base import BaseGenerator
+from consensus_tpu.methods.beam_search import BIAS_AGAINST_TOKENS, EOS_TOKENS
+from consensus_tpu.methods.brushup import brushup_statement_ending
+from consensus_tpu.methods.prompts import agent_prompt, reference_prompt
+
+FAILURE_REWARD = -100.0
+
+
+class Node:
+    __slots__ = (
+        "statement",
+        "token",
+        "parent",
+        "children",
+        "visits",
+        "total_reward",
+        "immediate_reward",
+        "untried",
+        "is_terminal",
+    )
+
+    def __init__(self, statement: str, token: Optional[str], parent: Optional["Node"]):
+        self.statement = statement
+        self.token = token
+        self.parent = parent
+        self.children: Dict[str, Node] = {}
+        self.visits = 0
+        self.total_reward = 0.0
+        self.immediate_reward = 0.0
+        self.untried: Optional[List] = None  # None = never expanded
+        self.is_terminal = token in EOS_TOKENS if token is not None else False
+
+    @property
+    def value(self) -> float:
+        return self.total_reward / self.visits if self.visits else 0.0
+
+
+class MCTSGenerator(BaseGenerator):
+    def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
+        cfg = self.config
+        self._num_simulations = int(cfg.get("num_simulations", 50))
+        self._c = float(cfg.get("exploration_constant", 1.414))
+        max_tokens = int(cfg.get("max_tokens", 100))
+        self._width = int(cfg.get("expansion_sample_width", 5))
+        self._rollout_depth = int(cfg.get("rollout_depth", 10))
+        self._gamma = float(cfg.get("gamma", 0.99))
+        self._temperature = float(cfg.get("temperature", 1.0))
+
+        self._issue = issue
+        self._agents = list(agent_opinions.items())
+        self._agent_opinions = agent_opinions
+        if not self._agents:
+            return ""
+
+        root = Node("", None, None)
+        for step in range(max_tokens):
+            for sim in range(self._num_simulations):
+                sim_seed = (
+                    self.seed + step * 10_000 + sim
+                    if self.seed is not None
+                    else None
+                )
+                leaf = self._select(root)
+                if leaf.is_terminal:
+                    reward, target = leaf.immediate_reward, leaf
+                else:
+                    child = self._expand_and_evaluate(leaf, sim_seed)
+                    if child is None:  # fully expanded with zero candidates
+                        reward, target = leaf.immediate_reward, leaf
+                    else:
+                        reward, target = child.immediate_reward, child
+                self._backpropagate(target, reward)
+
+            best = self._most_visited_child(root)
+            if best is None:
+                break
+            best.parent = None  # detach (reference :1005-1006)
+            root = best
+            if root.is_terminal:
+                break
+
+        statement = root.statement.strip()
+        self.pre_brushup_statement = statement
+        if cfg.get("brushup", False):
+            statement = brushup_statement_ending(
+                self.backend, statement, seed=self.seed
+            )
+        return statement
+
+    # -- phases --------------------------------------------------------------
+
+    def _select(self, node: Node) -> Node:
+        """UCB1 walk until a node with unexpanded candidates or a terminal."""
+        while not node.is_terminal:
+            if node.untried is None or node.untried:
+                return node
+            if not node.children:
+                return node
+            log_n = math.log(max(node.visits, 1))
+            node = max(
+                node.children.values(),
+                key=lambda ch: (
+                    math.inf
+                    if ch.visits == 0
+                    else ch.value + self._c * math.sqrt(log_n / ch.visits)
+                ),
+            )
+        return node
+
+    def _expand_and_evaluate(self, node: Node, seed) -> Optional[Node]:
+        if node.untried is None:
+            node.untried = self._propose_tokens(node.statement, seed)
+        if not node.untried:
+            return None
+        candidate = node.untried.pop(0)
+        child = Node(node.statement + candidate.token, candidate.token, node)
+        node.children[candidate.token] = child
+
+        immediate = self._agent_min_token_logprob(node.statement, candidate.token)
+        if child.is_terminal:
+            child.immediate_reward = immediate
+        else:
+            rollout_value = self._rollout(child.statement, seed)
+            child.immediate_reward = immediate + self._gamma * rollout_value
+        return child
+
+    def _propose_tokens(self, statement: str, seed) -> List:
+        system, user = reference_prompt(self._issue, self._agent_opinions)
+        return self.backend.next_token_logprobs(
+            [
+                NextTokenRequest(
+                    user_prompt=user + statement,
+                    system_prompt=system,
+                    k=self._width,
+                    temperature=self._temperature,
+                    seed=seed,
+                    mode="sample",
+                    bias_against_tokens=BIAS_AGAINST_TOKENS,
+                    chat=False,
+                )
+            ]
+        )[0]
+
+    def _agent_min_token_logprob(self, statement: str, token: str) -> float:
+        """Egalitarian immediate reward: min over agents of the token's
+        logprob (one batched score call; reference :249-329)."""
+        requests = [
+            ScoreRequest(
+                context=agent_prompt(self._issue, opinion)[1] + statement,
+                continuation=token,
+                system_prompt=agent_prompt(self._issue, opinion)[0],
+                chat=False,
+            )
+            for _, opinion in self._agents
+        ]
+        results = self.backend.score(requests)
+        rewards = [
+            (r.logprobs[-1] if r.ok else FAILURE_REWARD) for r in results
+        ]
+        return min(rewards) if rewards else FAILURE_REWARD
+
+    def _rollout(self, statement: str, seed) -> float:
+        """Continue ``rollout_depth`` tokens from the reference policy, then
+        value the rolled-out statement as min over agents of its TOTAL
+        logprob (reference :470-651; evaluated correctly — the reference
+        crashes here, SURVEY §2.6)."""
+        system, user = reference_prompt(self._issue, self._agent_opinions)
+        rollout = self.backend.generate(
+            [
+                GenerationRequest(
+                    user_prompt=user + statement,
+                    system_prompt=system,
+                    max_tokens=self._rollout_depth,
+                    temperature=self._temperature,
+                    seed=seed,
+                    chat=False,
+                )
+            ]
+        )[0]
+        if not rollout.ok:
+            return FAILURE_REWARD
+        full_statement = statement + rollout.text
+
+        requests = [
+            ScoreRequest(
+                context=agent_prompt(self._issue, opinion)[1],
+                continuation=full_statement,
+                system_prompt=agent_prompt(self._issue, opinion)[0],
+                chat=False,
+            )
+            for _, opinion in self._agents
+        ]
+        results = self.backend.score(requests)
+        totals = [r.total(default=FAILURE_REWARD) for r in results]
+        return min(totals) if totals else FAILURE_REWARD
+
+    @staticmethod
+    def _backpropagate(node: Optional[Node], reward: float) -> None:
+        while node is not None:
+            node.visits += 1
+            node.total_reward += reward
+            node = node.parent
+
+    @staticmethod
+    def _most_visited_child(root: Node) -> Optional[Node]:
+        if not root.children:
+            return None
+        return max(root.children.values(), key=lambda ch: ch.visits)

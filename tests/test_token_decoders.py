@@ -1,0 +1,155 @@
+"""Beam search / finite lookahead / MCTS on the deterministic fake backend.
+
+The reference's token-level decoders are untestable without the live API
+(SURVEY §4); these tests pin the search semantics bit-reproducibly.
+"""
+
+import pytest
+
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.methods import get_method_generator
+from consensus_tpu.methods.beam_search import (
+    BeamSearchGenerator,
+    EOS_TOKENS,
+    MIN_WORDS,
+)
+
+ISSUE = "Should schools adopt a four-day week?"
+OPINIONS = {
+    "Agent 1": "A shorter week improves wellbeing for students and teachers.",
+    "Agent 2": "Childcare burdens would fall on working parents.",
+    "Agent 3": "Evidence on learning outcomes is mixed; pilot first.",
+}
+
+
+@pytest.fixture()
+def backend():
+    return FakeBackend()
+
+
+class TestBeamSearch:
+    def make(self, backend, **cfg):
+        base = {"beam_width": 2, "max_tokens": 6, "seed": 5}
+        base.update(cfg)
+        return get_method_generator("beam_search", backend, base)
+
+    def test_produces_statement_and_batches_calls(self, backend):
+        gen = self.make(backend)
+        statement = gen.generate_statement(ISSUE, OPINIONS)
+        assert isinstance(statement, str) and statement
+        # Per step: ONE next-token batch + ONE score batch. 6 steps max.
+        assert backend.call_counts["next_token"] <= 6 * 2  # <= steps x beams
+        assert gen.pre_brushup_statement == statement  # no brushup configured
+
+    def test_deterministic(self):
+        s1 = self.make(FakeBackend()).generate_statement(ISSUE, OPINIONS)
+        s2 = self.make(FakeBackend()).generate_statement(ISSUE, OPINIONS)
+        assert s1 == s2
+
+    def test_prune_moves_eos_to_completed(self):
+        eos = next(iter(EOS_TOKENS))
+        candidates = [
+            ("good seq one two three four five", [2.0, 1.0], "tok"),
+            ("done seq" + eos, [0.5, 0.4], eos),
+            ("bad seq", [-5.0, -9.0], "tok"),
+        ]
+        beams, completed = BeamSearchGenerator._prune(candidates, [], beam_width=1)
+        assert len(beams) == 1 and beams[0][0].startswith("good")
+        assert len(completed) == 1 and completed[0][0].startswith("done")
+
+    def test_select_best_filters_short_sequences(self):
+        completed = [
+            ("short one", [10.0, 10.0]),  # 2 words: filtered despite reward
+            ("a much longer sequence of words here", [1.0, 2.0]),
+        ]
+        assert BeamSearchGenerator._select_best(completed).startswith("a much")
+
+    def test_select_best_falls_back_when_all_short(self):
+        completed = [("tiny", [1.0]), ("small one", [3.0])]
+        assert BeamSearchGenerator._select_best(completed) == "small one"
+
+    def test_min_words_constant_matches_reference(self):
+        assert MIN_WORDS == 5
+
+    def test_brushup_sets_pre_brushup_statement(self, backend):
+        gen = self.make(backend, brushup=True)
+        statement = gen.generate_statement(ISSUE, OPINIONS)
+        assert gen.pre_brushup_statement is not None
+        assert isinstance(statement, str)
+
+
+class TestFiniteLookahead:
+    def make(self, backend, **cfg):
+        base = {"branching_factor": 2, "max_depth": 2, "max_tokens": 5, "seed": 9}
+        base.update(cfg)
+        return get_method_generator("finite_lookahead", backend, base)
+
+    def test_produces_statement(self, backend):
+        gen = self.make(backend)
+        statement = gen.generate_statement(ISSUE, OPINIONS)
+        assert isinstance(statement, str) and statement
+
+    def test_deterministic(self):
+        s1 = self.make(FakeBackend()).generate_statement(ISSUE, OPINIONS)
+        s2 = self.make(FakeBackend()).generate_statement(ISSUE, OPINIONS)
+        assert s1 == s2
+
+    def test_tree_paths_level_batching(self, backend):
+        gen = self.make(backend)
+        paths = gen._tree_paths(ISSUE, OPINIONS, "", 2, 3, 1.0, seed=1)
+        # One batched call per level: frontier sizes 1, 2, 4 -> 7 requests
+        # but only 3 next_token CALL batches happen; counts track requests.
+        assert backend.call_counts["next_token"] == 1 + 2 + 4
+        assert 1 <= len(paths) <= 8
+        assert all(isinstance(p, list) and p for p in paths)
+
+    def test_appends_only_first_token_per_step(self, backend):
+        gen = self.make(backend, max_tokens=1)
+        statement = gen.generate_statement(ISSUE, OPINIONS)
+        # After one outer step the statement is exactly one token.
+        paths = []  # statement must equal some single proposed token
+        assert len(statement) < 30
+
+
+class TestMCTS:
+    def make(self, backend, **cfg):
+        base = {
+            "num_simulations": 4,
+            "expansion_sample_width": 3,
+            "max_tokens": 4,
+            "rollout_depth": 3,
+            "seed": 2,
+        }
+        base.update(cfg)
+        return get_method_generator("mcts", backend, base)
+
+    def test_produces_statement_without_crashing(self, backend):
+        """The reference MCTS raises NameError in every rollout evaluation
+        (mcts.py:614-616); ours must complete."""
+        gen = self.make(backend)
+        statement = gen.generate_statement(ISSUE, OPINIONS)
+        assert isinstance(statement, str) and statement
+
+    def test_deterministic(self):
+        s1 = self.make(FakeBackend()).generate_statement(ISSUE, OPINIONS)
+        s2 = self.make(FakeBackend()).generate_statement(ISSUE, OPINIONS)
+        assert s1 == s2
+
+    def test_visits_accumulate(self, backend):
+        from consensus_tpu.methods.mcts import MCTSGenerator, Node
+
+        root = Node("", None, None)
+        child = Node("x", "x", root)
+        MCTSGenerator._backpropagate(child, 1.5)
+        MCTSGenerator._backpropagate(child, 0.5)
+        assert child.visits == 2 and root.visits == 2
+        assert child.value == pytest.approx(1.0)
+
+    def test_most_visited_child_advances(self, backend):
+        from consensus_tpu.methods.mcts import MCTSGenerator, Node
+
+        root = Node("", None, None)
+        a, b = Node("a", "a", root), Node("b", "b", root)
+        root.children = {"a": a, "b": b}
+        a.visits, b.visits = 3, 7
+        assert MCTSGenerator._most_visited_child(root) is b
