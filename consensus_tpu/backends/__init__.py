@@ -47,7 +47,12 @@ def get_backend(spec: Optional[Any] = None, **kwargs) -> Backend:
     else:
         return spec  # already a backend instance
 
-    cache_key = name if not kwargs else None
+    # Cache on (name, kwargs) so repeated resolutions — e.g. an in-process
+    # config sweep — reuse one backend and its compiled device programs.
+    try:
+        cache_key = f"{name}:{sorted(kwargs.items())!r}"
+    except TypeError:  # unhashable/unsortable kwargs: skip caching
+        cache_key = None
     if cache_key and cache_key in _BACKEND_CACHE:
         return _BACKEND_CACHE[cache_key]
 

@@ -53,6 +53,9 @@ VOCAB: List[str] = [f" {w}" for w in _WORDS] + _PUNCT + _EOS_TOKENS
 _RANK_PROMPT_MARKER = "Arrow notation"
 _ENVELOPE_MARKER = "<answer>"
 _STATEMENT_LINE_RE = re.compile(r"^([A-Z])\. ", re.MULTILINE)
+_JUDGE_RANKING_MARKER = "method_ranking"
+_JUDGE_SCORE_MARKER = "representation score"
+_METHOD_LINE_RE = re.compile(r"^\d+\. \[([^\]]+)\]", re.MULTILINE)
 
 
 def _digest(*parts) -> bytes:
@@ -114,12 +117,45 @@ class FakeBackend:
         body = self._pseudo_sentence(_digest("env", prompt, seed), max_tokens)
         return f"<answer>\nFake step-by-step reasoning.\n<sep>\n{body}\n</answer>"
 
+    def _judge_ranking_response(self, prompt: str, seed) -> str:
+        """Deterministic LLM-judge JSON: a permutation ranking of the
+        ``N. [method] statement`` lines found in the prompt."""
+        methods = _METHOD_LINE_RE.findall(prompt)
+        if not methods:
+            methods = ["unknown"]
+        rng = _rng("judge-rank", prompt, seed)
+        order = list(rng.permutation(len(methods)))
+        ranking = {m: int(order[i]) + 1 for i, m in enumerate(methods)}
+        import json as _json
+
+        return _json.dumps(
+            {
+                "reasoning": "Deterministic fake comparative judgement.",
+                "method_ranking": ranking,
+            }
+        )
+
+    def _judge_score_response(self, prompt: str, seed) -> str:
+        score = 1 + int(_hash_unit_float("judge-score", prompt, seed) * 5) % 5
+        import json as _json
+
+        return _json.dumps(
+            {
+                "representation score": score,
+                "explanation": "Deterministic fake representation judgement.",
+            }
+        )
+
     def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
         self.call_counts["generate"] += len(requests)
         results = []
         for req in requests:
             prompt = self._full_prompt(req)
-            if self.instruction_following and _RANK_PROMPT_MARKER in prompt:
+            if self.instruction_following and _JUDGE_RANKING_MARKER in prompt:
+                text = self._judge_ranking_response(prompt, req.seed)
+            elif self.instruction_following and _JUDGE_SCORE_MARKER in prompt:
+                text = self._judge_score_response(prompt, req.seed)
+            elif self.instruction_following and _RANK_PROMPT_MARKER in prompt:
                 text = self._ranking_response(prompt, req.seed)
             elif self.instruction_following and _ENVELOPE_MARKER in prompt:
                 text = self._envelope_response(prompt, req.seed, req.max_tokens)

@@ -1,0 +1,1 @@
+"""Command-line entry points (L7; reference repo-root runners, SURVEY §2.12)."""

@@ -1,0 +1,119 @@
+"""Re-evaluate an existing run directory with different models.
+
+Reference: ``post_hoc_evaluate.py`` (614 LoC; SURVEY §2.12): the phase-split
+artifact contract makes evaluation a separate, re-runnable pass over
+``results.csv`` + ``config.yaml`` (SURVEY §5.4) — any old run can be
+re-scored with any model, plus ad-hoc statements from a text file.
+
+Usage:
+    python -m consensus_tpu.cli.post_hoc_evaluate --results-dir results/run_x \
+        --evaluation-models fake-lm [--with-judge] [--backend fake]
+    python -m consensus_tpu.cli.post_hoc_evaluate --statements-text stmts.txt \
+        --issue "..." --opinions opinions.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pathlib
+import sys
+from typing import List, Optional
+
+import pandas as pd
+import yaml
+
+from consensus_tpu.aggregation import aggregate_run_dir
+from consensus_tpu.cli.run_experiment import configure_logging
+from consensus_tpu.evaluation import StatementEvaluator
+from consensus_tpu.backends import get_backend
+
+logger = logging.getLogger(__name__)
+
+
+def evaluate_run_dir(
+    results_dir: str,
+    evaluation_models: List[str],
+    backend_name: Optional[str] = None,
+    with_judge: bool = False,
+) -> None:
+    run_dir = pathlib.Path(results_dir)
+    with open(run_dir / "config.yaml") as fh:
+        config = yaml.safe_load(fh)
+    backend = get_backend(
+        backend_name or config.get("backend", "fake"),
+        **(config.get("backend_options") or {}),
+    )
+    judge = backend if with_judge else None
+    for model in evaluation_models:
+        evaluator = StatementEvaluator(
+            backend, evaluation_model=model, judge_backend=judge
+        )
+        evaluator.evaluate_results_file(
+            str(run_dir / "results.csv"), config=config,
+            include_llm_judge=with_judge,
+        )
+        logger.info("Re-evaluated with %s", model)
+    aggregate_run_dir(str(run_dir))
+
+
+def evaluate_adhoc_statements(
+    statements_file: str,
+    issue: str,
+    opinions_file: str,
+    backend_name: str,
+    evaluation_model: str,
+) -> pd.DataFrame:
+    """Score statements from a text file (one per line) against a scenario
+    (reference :488-612)."""
+    with open(opinions_file) as fh:
+        agent_opinions = yaml.safe_load(fh)
+    statements = [
+        line.strip()
+        for line in pathlib.Path(statements_file).read_text().splitlines()
+        if line.strip()
+    ]
+    backend = get_backend(backend_name)
+    evaluator = StatementEvaluator(backend, evaluation_model=evaluation_model)
+    rows = []
+    for statement in statements:
+        metrics = evaluator.evaluate_statement(statement, issue, agent_opinions)
+        rows.append({"statement": statement, **metrics})
+    return pd.DataFrame(rows)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="Post-hoc evaluation")
+    parser.add_argument("--results-dir")
+    parser.add_argument("--evaluation-models", nargs="*", default=["fake-lm"])
+    parser.add_argument("--backend", default=None)
+    parser.add_argument("--with-judge", action="store_true")
+    parser.add_argument("--statements-text", help="ad-hoc statements file")
+    parser.add_argument("--issue", default="")
+    parser.add_argument("--opinions", help="YAML {agent: opinion} file")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    configure_logging(args.quiet)
+    if args.results_dir:
+        evaluate_run_dir(
+            args.results_dir, args.evaluation_models, args.backend, args.with_judge
+        )
+        print(f"Re-evaluated: {args.results_dir}")
+        return 0
+    if args.statements_text:
+        frame = evaluate_adhoc_statements(
+            args.statements_text,
+            args.issue,
+            args.opinions,
+            args.backend or "fake",
+            args.evaluation_models[0],
+        )
+        print(frame.to_string(index=False))
+        return 0
+    parser.error("Provide --results-dir or --statements-text")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Generation phase CLI.
+
+Reference: ``run_experiment.py`` (SURVEY §2.12) — load a YAML config,
+configure logging (DEBUG root, noisy libraries suppressed, reference
+:57-82), run the experiment, print the result frame.
+
+Usage: ``python -m consensus_tpu.cli.run_experiment -c config.yaml``
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import List, Optional
+
+import pandas as pd
+import yaml
+
+from consensus_tpu.experiment import Experiment
+
+
+def configure_logging(quiet: bool = False) -> None:
+    level = logging.WARNING if quiet else logging.INFO
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        force=True,
+    )
+    for noisy in ("jax", "urllib3", "httpx", "transformers"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)
+
+
+def run_experiment_from_config(config_path: str) -> "tuple[pd.DataFrame, str]":
+    """Run the generation phase; returns (results frame, run dir path)."""
+    with open(config_path) as fh:
+        config = yaml.safe_load(fh)
+    experiment = Experiment(config)
+    frame = experiment.run()
+    return frame, str(experiment.run_dir)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="Run a consensus experiment")
+    parser.add_argument("-c", "--config", required=True, help="YAML config path")
+    parser.add_argument("--quiet", action="store_true", help="less logging")
+    args = parser.parse_args(argv)
+
+    configure_logging(args.quiet)
+    frame, run_dir = run_experiment_from_config(args.config)
+    with pd.option_context("display.max_colwidth", 80, "display.width", 200):
+        print(frame.to_string(index=False))
+    print(f"\nRun directory: {run_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

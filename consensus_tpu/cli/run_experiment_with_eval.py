@@ -1,0 +1,145 @@
+"""Full pipeline CLI: generate → judge → evaluate → aggregate.
+
+Reference: ``run_experiment_with_eval.py`` (513 LoC; SURVEY §2.12, §3.2):
+Phase 1 generation, Phase 2a per-seed LLM-judge comparative ranking
+(``evaluation/llm_judge/seed_N/{ranking_results.csv, ranking_reasoning.csv,
+comparative_ranking_matrix.json}``), Phase 2b per-(model × seed) standard
+evaluation (``evaluation/<model>/seed_N/``), Phase 3 aggregation.
+
+Flags mirror the reference (:465-509): ``--skip-comparative-ranking``,
+``--llm-judge-model``, ``--evaluation-models``, ``--quiet``.  The judge runs
+on whatever backend the config names (``judge_backend`` key, default: the
+generation backend) — the reference hardcoded OpenAI there.
+
+Usage: ``python -m consensus_tpu.cli.run_experiment_with_eval -c config.yaml``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import pathlib
+import sys
+from typing import List, Optional
+
+import pandas as pd
+import yaml
+
+from consensus_tpu.aggregation import aggregate_run_dir
+from consensus_tpu.cli.run_experiment import configure_logging
+from consensus_tpu.backends import get_backend
+from consensus_tpu.evaluation import StatementEvaluator, sanitize_model_name
+from consensus_tpu.experiment import Experiment
+from consensus_tpu.utils.identifiers import create_method_identifier
+
+logger = logging.getLogger(__name__)
+
+
+def run_pipeline(
+    config_path: str,
+    skip_comparative_ranking: bool = False,
+    llm_judge_model: str = "",
+    evaluation_models: Optional[List[str]] = None,
+) -> str:
+    with open(config_path) as fh:
+        config = yaml.safe_load(fh)
+
+    # ---- Phase 1: generation ------------------------------------------
+    logger.info("=== Phase 1: generation ===")
+    experiment = Experiment(config)
+    results = experiment.run()
+    run_dir = pathlib.Path(experiment.run_dir)
+    backend = experiment.backend
+
+    scenario = config.get("scenario", {})
+    issue = scenario.get("issue", "")
+    agent_opinions = dict(scenario.get("agent_opinions", {}))
+
+    # ---- Phase 2a: per-seed comparative ranking -----------------------
+    if not skip_comparative_ranking:
+        logger.info("=== Phase 2a: LLM-judge comparative ranking ===")
+        # Judge backend construction is deferred to here: with the phase
+        # skipped, a judge_backend: tpu config must not pay a model load.
+        judge_options = dict(config.get("judge_backend_options") or {})
+        if llm_judge_model:
+            # Route the requested judge model to the backend (the reference
+            # aliases judge "o3" -> gpt-4.1 inside its OpenAI path,
+            # src/evaluation.py:447-462; ours is the backend's concern).
+            judge_options.setdefault("model", llm_judge_model)
+        judge_backend = (
+            get_backend(config["judge_backend"], **judge_options)
+            if config.get("judge_backend")
+            else backend
+        )
+        evaluator = StatementEvaluator(
+            backend, judge_backend=judge_backend, llm_judge_model=llm_judge_model
+        )
+        for seed_index, seed in enumerate(sorted(results["seed"].unique())):
+            subset = results[
+                (results["seed"] == seed)
+                & (results["statement"].astype(str).str.strip() != "")
+                & (results["error_message"].fillna("").astype(str).str.strip() == "")
+            ]
+            method_statements = {}
+            for index, row in subset.iterrows():
+                params = {
+                    k: row[k]
+                    for k in subset.columns
+                    if k.startswith("param_") and pd.notna(row[k])
+                }
+                key = create_method_identifier(row["method"], params)
+                method_statements[key] = row["statement"]
+            if len(method_statements) < 2:
+                logger.info("Seed %s: <2 statements, skipping ranking", seed)
+                continue
+            ranking, reasoning, matrix = evaluator.evaluate_comparative_rankings(
+                method_statements, issue, agent_opinions, seed=int(seed)
+            )
+            seed_dir = run_dir / "evaluation" / "llm_judge" / f"seed_{seed_index}"
+            seed_dir.mkdir(parents=True, exist_ok=True)
+            ranking.to_csv(seed_dir / "ranking_results.csv", index=False)
+            reasoning.to_csv(seed_dir / "ranking_reasoning.csv", index=False)
+            with open(seed_dir / "comparative_ranking_matrix.json", "w") as fh:
+                json.dump(matrix, fh, indent=2)
+
+    # ---- Phase 2b: per-(model x seed) standard evaluation -------------
+    logger.info("=== Phase 2b: standard evaluation ===")
+    # experiment.evaluation_models already resolves the plural key, the
+    # singular evaluation_model back-compat key, and defaults.
+    models = evaluation_models or experiment.evaluation_models or [
+        config.get("models", {}).get("generation_model", "model")
+    ]
+    for model in models:
+        evaluator = StatementEvaluator(backend, evaluation_model=model)
+        evaluator.evaluate_results_file(str(run_dir / "results.csv"), config=config)
+        logger.info("Evaluated with %s", sanitize_model_name(model))
+
+    # ---- Phase 3: aggregation -----------------------------------------
+    logger.info("=== Phase 3: aggregation ===")
+    aggregate_run_dir(str(run_dir))
+    return str(run_dir)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="Run experiment + evaluation")
+    parser.add_argument("-c", "--config", required=True)
+    parser.add_argument("--skip-comparative-ranking", action="store_true")
+    parser.add_argument("--llm-judge-model", default="")
+    parser.add_argument("--evaluation-models", nargs="*", default=None)
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    configure_logging(args.quiet)
+    run_dir = run_pipeline(
+        args.config,
+        skip_comparative_ranking=args.skip_comparative_ranking,
+        llm_judge_model=args.llm_judge_model,
+        evaluation_models=args.evaluation_models,
+    )
+    print(f"Pipeline complete: {run_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

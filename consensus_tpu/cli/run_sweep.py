@@ -1,0 +1,97 @@
+"""Paper-sweep driver: run every matching scenario/method config.
+
+Reference: ``run_aamas_experiments.py`` (157 LoC; SURVEY §2.12, §3.1) —
+globs ``configs/sweeps/{model}/scenario_N/{method}.yaml`` and runs the full
+pipeline for each, with model/scenario/method filters.  One redesign: the
+reference shells out a subprocess per config (:66-75); here each config runs
+in-process so the TPU backend's compiled programs are REUSED across the
+sweep — recompiling a 2B-model decode loop per subprocess would dwarf the
+actual compute.
+
+Usage: ``python -m consensus_tpu.cli.run_sweep --configs-root configs/sweeps
+[--model gemma] [--scenario 1 2] [--method best_of_n]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pathlib
+import re
+import sys
+import time
+from typing import List, Optional
+
+from consensus_tpu.cli.run_experiment import configure_logging
+from consensus_tpu.cli.run_experiment_with_eval import run_pipeline
+
+logger = logging.getLogger(__name__)
+
+_SCENARIO_RE = re.compile(r"scenario_(\d+)")
+
+
+def find_config_files(
+    root: str,
+    models: Optional[List[str]] = None,
+    scenarios: Optional[List[int]] = None,
+    methods: Optional[List[str]] = None,
+) -> List[pathlib.Path]:
+    """Glob ``{root}/{model}/scenario_N/{method}.yaml`` with filters
+    (reference find_config_files, :21-56)."""
+    configs = []
+    root_path = pathlib.Path(root)
+    for path in sorted(root_path.glob("*/scenario_*/*.yaml")):
+        model = path.parent.parent.name
+        scenario_match = _SCENARIO_RE.search(path.parent.name)
+        scenario = int(scenario_match.group(1)) if scenario_match else None
+        method = path.stem
+        if models and model not in models:
+            continue
+        if scenarios and scenario not in scenarios:
+            continue
+        if methods and method not in methods:
+            continue
+        configs.append(path)
+    return configs
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="Run a config sweep")
+    parser.add_argument("--configs-root", default="configs/sweeps")
+    parser.add_argument("--model", nargs="*", default=None)
+    parser.add_argument("--scenario", nargs="*", type=int, default=None)
+    parser.add_argument("--method", nargs="*", default=None)
+    parser.add_argument("--skip-comparative-ranking", action="store_true")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    configure_logging(args.quiet)
+    configs = find_config_files(
+        args.configs_root, args.model, args.scenario, args.method
+    )
+    if not configs:
+        logger.error("No configs matched under %s", args.configs_root)
+        return 1
+
+    logger.info("Running %d configs", len(configs))
+    failures = 0
+    for i, config in enumerate(configs, 1):
+        logger.info("[%d/%d] %s", i, len(configs), config)
+        start = time.perf_counter()
+        try:
+            run_dir = run_pipeline(
+                str(config),
+                skip_comparative_ranking=args.skip_comparative_ranking,
+            )
+            logger.info(
+                "[%d/%d] done in %.1fs -> %s",
+                i, len(configs), time.perf_counter() - start, run_dir,
+            )
+        except Exception:
+            logger.exception("[%d/%d] FAILED: %s", i, len(configs), config)
+            failures += 1
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

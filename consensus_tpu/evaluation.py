@@ -1,0 +1,414 @@
+"""Statement evaluation: per-agent utilities, welfare metrics, LLM judge.
+
+Reference: ``src/evaluation.py`` (1 644 LoC; SURVEY §2.10).  Output schema
+parity is exact — column names match the reference's
+``evaluation_results.csv`` / ``ranking_results.csv`` so downstream
+aggregation is interchangeable.  Per statement:
+
+* cosine-similarity utilities: statement + opinion embeddings (one batched
+  ``embed`` call) → per-agent cosine (reference :161-272);
+* logprob utilities: the statement teacher-force-scored under an
+  agent-aligned evaluation prompt (one batched ``score`` call over agents)
+  → per-agent avg logprob, avg probability ``mean(exp(lp))``, perplexity
+  ``exp(-avg_logprob)`` (reference :182-230, 329-335);
+* welfare per utility family (reference :274-394): egalitarian = min,
+  utilitarian = sum, log-Nash = ``sum(log(max(u, 1e-9)))`` — with the
+  reference's convention that *egalitarian perplexity is the MAX* because
+  lower perplexity is better (:366-391);
+* optional LLM-judge 1-5 representation scores per agent and a comparative
+  ranking across all methods' statements (reference :413-632, 636-893) via
+  a pluggable judge backend (the reference hardcodes OpenAI; judge
+  "o3" aliases to gpt-4.1 there, :447-462 — routing happens in the API
+  backend here).
+
+The (statements × agents) utility tensor is assembled in single batched
+backend calls — the decoder-side redesign (SURVEY §2.16) applied to
+evaluation.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pathlib
+import re
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+import yaml
+
+from consensus_tpu.backends.base import Backend, GenerationRequest, ScoreRequest
+from consensus_tpu.utils.identifiers import create_method_identifier
+
+logger = logging.getLogger(__name__)
+
+UTILITY_EPSILON = 1e-9
+
+#: Agent-aligned scoring context (reference src/evaluation.py:182-193).
+EVAL_SYSTEM_TEMPLATE = (
+    "Issue: {issue}\n\nAgent's Opinion: {opinion}\n\n"
+    "Here is a consensus statement that perfectly aligns with the agent's "
+    "opinion:"
+)
+
+_JSON_RE = re.compile(r"\{.*\}", re.DOTALL)
+
+
+def _welfare_triplet(utilities: np.ndarray) -> Tuple[float, float, float]:
+    """(egalitarian, utilitarian, log-Nash) for higher-is-better utilities."""
+    return (
+        float(np.min(utilities)),
+        float(np.sum(utilities)),
+        float(np.sum(np.log(np.maximum(utilities, UTILITY_EPSILON)))),
+    )
+
+
+class StatementEvaluator:
+    def __init__(
+        self,
+        backend: Backend,
+        evaluation_model: str = "",
+        judge_backend: Optional[Backend] = None,
+        llm_judge_model: str = "",
+    ):
+        self.backend = backend
+        self.evaluation_model = evaluation_model
+        self.judge_backend = judge_backend
+        self.llm_judge_model = llm_judge_model
+
+    # ------------------------------------------------------------------
+    # Single-statement metrics
+    # ------------------------------------------------------------------
+
+    def evaluate_statement(
+        self,
+        statement: str,
+        issue: str,
+        agent_opinions: Dict[str, str],
+        include_llm_judge: bool = False,
+    ) -> Dict[str, Any]:
+        agents = list(agent_opinions.items())
+        metrics: Dict[str, Any] = {}
+
+        # -- cosine utilities (one embed batch) ---------------------------
+        vectors = self.backend.embed([statement] + [op for _, op in agents])
+        statement_vec, opinion_vecs = vectors[0], vectors[1:]
+        cosines = opinion_vecs @ statement_vec  # embeddings are unit-norm
+        for (name, _), cos in zip(agents, cosines):
+            metrics[f"cosine_similarity_{name}"] = float(cos)
+            metrics[f"utility_cosine_similarity_{name}"] = float(cos)
+
+        # -- logprob utilities (one score batch over agents) --------------
+        requests = [
+            ScoreRequest(
+                context=EVAL_SYSTEM_TEMPLATE.format(issue=issue, opinion=opinion),
+                continuation=statement,
+                chat=True,
+            )
+            for _, opinion in agents
+        ]
+        results = self.backend.score(requests)
+        avg_logprobs, avg_probs, perplexities = [], [], []
+        for (name, _), result in zip(agents, results):
+            lps = np.asarray(result.logprobs, dtype=np.float64)
+            avg_lp = float(lps.mean()) if lps.size else -10.0
+            avg_p = float(np.exp(lps).mean()) if lps.size else 0.0
+            ppl = float(np.exp(-avg_lp))
+            avg_logprobs.append(avg_lp)
+            avg_probs.append(avg_p)
+            perplexities.append(ppl)
+            metrics[f"avg_logprob_{name}"] = avg_lp
+            metrics[f"utility_avg_logprob_{name}"] = avg_lp
+            metrics[f"perplexity_{name}"] = ppl
+
+        # -- welfare blocks ------------------------------------------------
+        egal, util, nash = _welfare_triplet(np.asarray(cosines))
+        metrics["egalitarian_welfare_cosine"] = egal
+        metrics["utility_egalitarian_welfare_cosine"] = egal
+        metrics["utilitarian_welfare_cosine"] = util
+        metrics["utility_utilitarian_welfare_cosine"] = util
+        metrics["log_nash_welfare_cosine"] = nash
+        metrics["utility_log_nash_welfare_cosine"] = nash
+
+        egal, util, nash = _welfare_triplet(np.asarray(avg_probs))
+        metrics["egalitarian_welfare_avg_prob"] = egal
+        metrics["utility_egalitarian_welfare_logprob"] = egal
+        metrics["utilitarian_welfare_avg_prob"] = util
+        metrics["utility_utilitarian_welfare_logprob"] = util
+        metrics["log_nash_welfare_avg_prob"] = nash
+        metrics["utility_log_nash_welfare_logprob"] = nash
+
+        ppl_arr = np.asarray(perplexities)
+        # Egalitarian perplexity = MAX: the worst-off agent has the highest
+        # perplexity (reference :366-391).
+        metrics["egalitarian_welfare_perplexity"] = float(np.max(ppl_arr))
+        metrics["utilitarian_welfare_perplexity"] = float(np.sum(ppl_arr))
+        metrics["log_nash_welfare_perplexity"] = float(
+            np.sum(np.log(np.maximum(ppl_arr, UTILITY_EPSILON)))
+        )
+
+        # -- optional LLM-judge representation scores ----------------------
+        if include_llm_judge and self.judge_backend is not None:
+            judge_scores = self._judge_scores(statement, issue, agents)
+            for (name, _), score in zip(agents, judge_scores):
+                metrics[f"judge_score_{name}"] = score
+            valid = np.asarray([s for s in judge_scores if s is not None])
+            if valid.size:
+                egal, util, nash = _welfare_triplet(valid)
+                metrics["egalitarian_welfare_judge_score"] = egal
+                metrics["utilitarian_welfare_judge_score"] = util
+                metrics["log_nash_welfare_judge_score"] = nash
+
+        return metrics
+
+    def _judge_scores(
+        self, statement: str, issue: str, agents: List[Tuple[str, str]]
+    ) -> List[Optional[float]]:
+        """1-5 representation score per agent, JSON-mode judge calls
+        (reference :413-579), batched over agents."""
+        requests = [
+            GenerationRequest(
+                user_prompt=(
+                    "You are an impartial judge. Rate on a 1-5 scale how well "
+                    "the consensus statement represents this participant's "
+                    "opinion. Respond in JSON: "
+                    '{"representation score": <1-5>, "explanation": "..."}\n\n'
+                    f"Issue: {issue}\n\nParticipant's opinion: {opinion}\n\n"
+                    f"Consensus statement: {statement}"
+                ),
+                max_tokens=300,
+                temperature=0.0,
+                chat=True,
+            )
+            for _, opinion in agents
+        ]
+        results = self.judge_backend.generate(requests)
+        scores: List[Optional[float]] = []
+        for result in results:
+            payload = _extract_json(result.text) if result.ok else None
+            score = payload.get("representation score") if payload else None
+            try:
+                score = float(score)
+                scores.append(score if 1.0 <= score <= 5.0 else None)
+            except (TypeError, ValueError):
+                scores.append(None)
+        return scores
+
+    # ------------------------------------------------------------------
+    # Comparative ranking across methods (one judge call per agent)
+    # ------------------------------------------------------------------
+
+    def evaluate_comparative_rankings(
+        self,
+        method_statements: Dict[str, str],
+        issue: str,
+        agent_opinions: Dict[str, str],
+        seed: Optional[int] = None,
+    ) -> Tuple[pd.DataFrame, pd.DataFrame, Dict[str, Any]]:
+        """Rank every method's statement from each agent's perspective.
+
+        Returns (ranking_results, ranking_reasoning, matrix) mirroring the
+        reference's three artifacts (run_experiment_with_eval.py:297-320):
+        per-method rank stats incl. ``is_maximin_best`` (method minimizing
+        its worst-case rank, reference src/evaluation.py:861-876) and
+        ``is_utilitarian_best`` (lowest average rank, :878-891).
+        """
+        if self.judge_backend is None:
+            raise ValueError("evaluate_comparative_rankings needs a judge backend")
+        methods = list(method_statements)
+        agents = list(agent_opinions.items())
+        start = time.perf_counter()
+
+        numbered = "\n".join(
+            f"{i + 1}. [{m}] {method_statements[m]}" for i, m in enumerate(methods)
+        )
+        requests = [
+            GenerationRequest(
+                user_prompt=(
+                    "You are an impartial judge. Rank ALL the candidate "
+                    "consensus statements below by how well each represents "
+                    "this participant's opinion (rank 1 = best). Respond in "
+                    'JSON: {"reasoning": "...", "method_ranking": '
+                    '{"<method>": <rank>, ...}} using every method exactly '
+                    "once.\n\n"
+                    f"Issue: {issue}\n\nParticipant's opinion: {opinion}\n\n"
+                    f"Candidate statements:\n{numbered}"
+                ),
+                max_tokens=1000,
+                temperature=0.0,
+                seed=seed,
+                chat=True,
+            )
+            for _, opinion in agents
+        ]
+        responses = self.judge_backend.generate(requests)
+
+        rank_matrix: Dict[str, Dict[str, Optional[int]]] = {m: {} for m in methods}
+        reasoning_rows = []
+        for (agent_name, _), response in zip(agents, responses):
+            payload = _extract_json(response.text) if response.ok else None
+            ranking = (payload or {}).get("method_ranking") or {}
+            reasoning_rows.append(
+                {
+                    "agent": agent_name,
+                    "reasoning": (payload or {}).get("reasoning", ""),
+                    "raw_response": response.text,
+                }
+            )
+            for method in methods:
+                value = ranking.get(method)
+                try:
+                    rank_matrix[method][agent_name] = int(value)
+                except (TypeError, ValueError):
+                    rank_matrix[method][agent_name] = None
+
+        from consensus_tpu.utils.identifiers import parse_method_identifier
+
+        rows = []
+        for method in methods:
+            base, params, _ = parse_method_identifier(method)
+            ranks = [r for r in rank_matrix[method].values() if r is not None]
+            row: Dict[str, Any] = {
+                "method": base,
+                "seed": seed,
+                "method_with_params": method,
+                **{f"param_{k}": v for k, v in params.items()},
+                "min_rank": min(ranks) if ranks else None,
+                "max_rank": max(ranks) if ranks else None,
+                "avg_rank": float(np.mean(ranks)) if ranks else None,
+            }
+            for agent_name, _ in agents:
+                row[f"rank_{agent_name}"] = rank_matrix[method][agent_name]
+            rows.append(row)
+        frame = pd.DataFrame(rows)
+
+        if frame["max_rank"].notna().any():
+            best_max = frame["max_rank"].min()
+            frame["is_maximin_best"] = (frame["max_rank"] == best_max).astype(int)
+        else:
+            frame["is_maximin_best"] = 0
+        if frame["avg_rank"].notna().any():
+            best_avg = frame["avg_rank"].min()
+            frame["is_utilitarian_best"] = (frame["avg_rank"] == best_avg).astype(int)
+        else:
+            frame["is_utilitarian_best"] = 0
+
+        matrix = {
+            "methods": methods,
+            "agents": [name for name, _ in agents],
+            "ranks": {m: rank_matrix[m] for m in methods},
+            "comparative_ranking_time_s": round(time.perf_counter() - start, 3),
+        }
+        return frame, pd.DataFrame(reasoning_rows), matrix
+
+    # ------------------------------------------------------------------
+    # Results-file driver
+    # ------------------------------------------------------------------
+
+    def evaluate_results_frame(
+        self,
+        results: pd.DataFrame,
+        issue: str,
+        agent_opinions: Dict[str, str],
+        include_llm_judge: bool = False,
+    ) -> pd.DataFrame:
+        """Evaluate every statement row of a generation results frame
+        (reference evaluate_statements, :895-1019)."""
+        rows = []
+        for index, row in results.iterrows():
+            statement = row.get("statement", "")
+            if not isinstance(statement, str) or not statement.strip():
+                continue
+            error = row.get("error_message")
+            if not pd.isna(error) and str(error).strip():
+                continue
+            params = {
+                k: row[k]
+                for k in results.columns
+                if k.startswith("param_") and pd.notna(row[k])
+            }
+            method_key = create_method_identifier(
+                row["method"], params, include_seed=True, seed_value=row.get("seed")
+            )
+            start = time.perf_counter()
+            metrics = self.evaluate_statement(
+                statement, issue, agent_opinions, include_llm_judge
+            )
+            out_row: Dict[str, Any] = {
+                "method": row["method"],
+                "issue": issue,
+                "statement": statement,
+                "method_with_params": method_key,
+                "seed": row.get("seed"),
+                "original_row_index": index,
+                "evaluation_time_s": round(time.perf_counter() - start, 3),
+            }
+            for k in params:
+                out_row[k] = params[k]
+            out_row.update(metrics)
+            rows.append(out_row)
+        return pd.DataFrame(rows)
+
+    def evaluate_results_file(
+        self,
+        results_csv: str,
+        config: Optional[Dict[str, Any]] = None,
+        output_dir: Optional[str] = None,
+        include_llm_judge: bool = False,
+    ) -> Dict[int, pd.DataFrame]:
+        """Per-seed evaluation of a run directory's results.csv, writing
+        ``evaluation/<model>/seed_N/evaluation_results.csv`` +
+        ``evaluation_config.yaml`` (reference :1072-1428)."""
+        results_path = pathlib.Path(results_csv)
+        run_dir = results_path.parent
+        if config is None:
+            with open(run_dir / "config.yaml") as fh:
+                config = yaml.safe_load(fh)
+        scenario = config.get("scenario", {})
+        issue = scenario.get("issue", "")
+        agent_opinions = dict(scenario.get("agent_opinions", {}))
+
+        results = pd.read_csv(results_csv)
+        model_dir = sanitize_model_name(self.evaluation_model or "model")
+        base = pathlib.Path(output_dir) if output_dir else run_dir / "evaluation"
+
+        frames: Dict[int, pd.DataFrame] = {}
+        for seed_index, seed in enumerate(sorted(results["seed"].unique())):
+            subset = results[results["seed"] == seed]
+            frame = self.evaluate_results_frame(
+                subset, issue, agent_opinions, include_llm_judge
+            )
+            seed_dir = base / model_dir / f"seed_{seed_index}"
+            seed_dir.mkdir(parents=True, exist_ok=True)
+            frame.to_csv(seed_dir / "evaluation_results.csv", index=False)
+            with open(seed_dir / "evaluation_config.yaml", "w") as fh:
+                yaml.safe_dump(
+                    {
+                        "evaluation_model": self.evaluation_model,
+                        "seed": int(seed),
+                        "include_llm_judge": include_llm_judge,
+                    },
+                    fh,
+                )
+            frames[int(seed)] = frame
+        return frames
+
+
+def sanitize_model_name(model: str) -> str:
+    """Model id → directory name (reference uses '/'→'_')."""
+    return model.replace("/", "_")
+
+
+def _extract_json(text: str) -> Optional[Dict[str, Any]]:
+    """Pull the first JSON object out of a judge response."""
+    if not text:
+        return None
+    match = _JSON_RE.search(text)
+    if not match:
+        return None
+    try:
+        return json.loads(match.group(0))
+    except json.JSONDecodeError:
+        return None

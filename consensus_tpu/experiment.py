@@ -1,0 +1,167 @@
+"""Experiment engine: seeds × methods × parameter grids → results.csv.
+
+Reference: ``src/experiment.py`` (SURVEY §2.9).  Behaviour-compatible
+artifact contract:
+
+* timestamped run directory ``{output_dir}/{experiment_name}_{YYYYmmdd_HHMMSS}``
+  with a ``config.yaml`` snapshot (reference :119-133);
+* per seed ``base_seed + i`` for ``num_seeds`` (reference :224-226);
+* list-valued method parameters expand to the Cartesian product of run
+  configs (reference :241-267);
+* each run records ``method, statement, generation_time_s, seed,
+  error_message, evaluation_status="pending"`` plus ``param_*`` columns and
+  ``pre_brushup_statement`` when a decoder retains one (reference :135-201);
+  evaluation is deliberately post-hoc (:190-192);
+* results ordered and saved to ``results.csv`` (:334-380).
+
+Architectural change: no thread pool and no rate limiter.  The reference
+fans method×param combos across a ``ThreadPoolExecutor`` to hide HTTP
+latency behind a token-bucket ``APIRateLimiter`` (:26-62, 283-322); with an
+on-device backend the model IS the bottleneck and requests inside each
+method are already batched device calls, so runs execute sequentially and
+the concurrency/rate-limit config keys are accepted and recorded but unused
+(SURVEY §2.16's table maps them to device batching).
+"""
+
+from __future__ import annotations
+
+import datetime
+import itertools
+import logging
+import pathlib
+import time
+from typing import Any, Dict, List, Optional
+
+import pandas as pd
+import yaml
+
+from consensus_tpu.backends import get_backend
+from consensus_tpu.backends.base import Backend
+from consensus_tpu.methods import get_method_generator
+
+logger = logging.getLogger(__name__)
+
+#: Result-row column order (reference src/experiment.py:334-367).
+_LEAD_COLUMNS = [
+    "method",
+    "statement",
+    "pre_brushup_statement",
+    "generation_time_s",
+    "seed",
+    "error_message",
+    "evaluation_status",
+]
+
+
+class Experiment:
+    def __init__(self, config: Dict[str, Any], backend: Optional[Backend] = None):
+        self.config = config
+        self.base_seed = int(config.get("seed", 42))
+        self.num_seeds = int(config.get("num_seeds", 1))
+
+        scenario = config.get("scenario", {})
+        self.issue: str = scenario.get("issue", "")
+        self.agent_opinions: Dict[str, str] = dict(scenario.get("agent_opinions", {}))
+
+        models = config.get("models", {})
+        self.generation_model: str = models.get("generation_model", "")
+        # Singular back-compat key (reference :90-100).
+        eval_models = models.get("evaluation_models")
+        if eval_models is None:
+            single = models.get("evaluation_model")
+            eval_models = [single] if single else []
+        self.evaluation_models: List[str] = list(eval_models)
+
+        self.methods_to_run: List[str] = list(config.get("methods_to_run", []))
+
+        if backend is not None:
+            self.backend = backend
+        else:
+            # get_backend caches by name so an in-process sweep (run_sweep)
+            # reuses one backend — and its compiled programs — across configs.
+            self.backend = get_backend(
+                config.get("backend", "fake"),
+                **(config.get("backend_options") or {}),
+            )
+
+        output_dir = pathlib.Path(config.get("output_dir", "results"))
+        name = config.get("experiment_name", "experiment")
+        stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+        self.run_dir = output_dir / f"{name}_{stamp}"
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        with open(self.run_dir / "config.yaml", "w") as fh:
+            yaml.safe_dump(config, fh, sort_keys=False)
+        logger.info("Run directory: %s", self.run_dir)
+
+    # -- run configs ---------------------------------------------------------
+
+    @staticmethod
+    def expand_param_grid(method_config: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """List-valued params → Cartesian product (reference :241-267)."""
+        listed = {k: v for k, v in method_config.items() if isinstance(v, list)}
+        if not listed:
+            return [dict(method_config)]
+        fixed = {k: v for k, v in method_config.items() if k not in listed}
+        configs = []
+        keys = sorted(listed)
+        for combo in itertools.product(*(listed[k] for k in keys)):
+            run_config = dict(fixed)
+            run_config.update(dict(zip(keys, combo)))
+            configs.append(run_config)
+        return configs
+
+    def _run_configs(self, seed: int) -> List[Dict[str, Any]]:
+        runs = []
+        for method in self.methods_to_run:
+            method_config = dict(self.config.get(method, {}) or {})
+            method_config["seed"] = seed
+            for run_config in self.expand_param_grid(method_config):
+                runs.append({"method": method, "config": run_config, "seed": seed})
+        return runs
+
+    # -- execution -----------------------------------------------------------
+
+    def _run_one(self, method: str, run_config: Dict[str, Any], seed: int) -> Dict:
+        row: Dict[str, Any] = {
+            "method": method,
+            "seed": seed,
+            "error_message": "",
+            "evaluation_status": "pending",
+        }
+        for key, value in run_config.items():
+            if key != "seed":
+                row[f"param_{key}"] = value
+        start = time.perf_counter()
+        try:
+            generator = get_method_generator(
+                method, self.backend, run_config, self.generation_model
+            )
+            statement = generator.generate_statement(self.issue, self.agent_opinions)
+            row["statement"] = statement
+            if generator.pre_brushup_statement is not None and run_config.get(
+                "brushup", False
+            ):
+                row["pre_brushup_statement"] = generator.pre_brushup_statement
+        except Exception as exc:  # error row, sweep continues (reference :194-201)
+            logger.exception("Method %s failed", method)
+            row["statement"] = ""
+            row["error_message"] = f"{type(exc).__name__}: {exc}"
+        row["generation_time_s"] = round(time.perf_counter() - start, 3)
+        return row
+
+    def run(self) -> pd.DataFrame:
+        rows = []
+        for i in range(self.num_seeds):
+            seed = self.base_seed + i
+            logger.info("=== Seed %d (%d/%d) ===", seed, i + 1, self.num_seeds)
+            for run in self._run_configs(seed):
+                logger.info("Running %s with %s", run["method"], run["config"])
+                rows.append(self._run_one(run["method"], run["config"], run["seed"]))
+
+        frame = pd.DataFrame(rows)
+        lead = [c for c in _LEAD_COLUMNS if c in frame.columns]
+        rest = sorted(c for c in frame.columns if c not in lead)
+        frame = frame[lead + rest]
+        frame.to_csv(self.run_dir / "results.csv", index=False)
+        logger.info("Saved %d rows to %s", len(frame), self.run_dir / "results.csv")
+        return frame

@@ -42,6 +42,9 @@ def create_method_identifier(
         for key, value in params_dict.items():
             name = key[len("param_"):] if key.startswith("param_") else key
             if name in IMPORTANT_PARAMETERS and value is not None:
+                # CSV round-trips turn ints into floats; keep keys stable.
+                if isinstance(value, float) and value.is_integer():
+                    value = int(value)
                 parts.append(f"{name}={value}")
         if parts:
             method_id = f"{method_id} ({', '.join(sorted(parts))})"

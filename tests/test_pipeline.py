@@ -1,0 +1,242 @@
+"""Experiment engine → evaluation → aggregation pipeline tests (fake backend).
+
+Covers the L4-L7 layers (SURVEY §2.9-2.12) the reference exercises only via
+live-API smoke configs.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import yaml
+
+from consensus_tpu.aggregation import aggregate_run_dir
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.evaluation import StatementEvaluator
+from consensus_tpu.experiment import Experiment
+
+ISSUE = "Should the library extend its opening hours?"
+OPINIONS = {
+    "Agent 1": "Students need late-night study space.",
+    "Agent 2": "Staff costs must stay within the current budget.",
+    "Agent 3": "Open later on weekends only.",
+}
+
+
+def base_config(tmp_path, **overrides):
+    config = {
+        "experiment_name": "test_run",
+        "seed": 42,
+        "num_seeds": 2,
+        "backend": "fake",
+        "models": {"generation_model": "fake-lm", "evaluation_models": ["fake-lm"]},
+        "scenario": {"issue": ISSUE, "agent_opinions": dict(OPINIONS)},
+        "methods_to_run": ["zero_shot", "best_of_n"],
+        "best_of_n": {"n": [2, 3], "max_tokens": 20},
+        "output_dir": str(tmp_path),
+    }
+    config.update(overrides)
+    return config
+
+
+class TestExperiment:
+    def test_param_grid_expansion(self):
+        configs = Experiment.expand_param_grid(
+            {"a": [1, 2], "b": ["x", "y"], "c": 7}
+        )
+        assert len(configs) == 4
+        assert {"a": 1, "b": "x", "c": 7} in configs
+        assert all(cfg["c"] == 7 for cfg in configs)
+
+    def test_scalar_config_passthrough(self):
+        assert Experiment.expand_param_grid({"a": 1}) == [{"a": 1}]
+
+    def test_run_produces_results_csv(self, tmp_path):
+        experiment = Experiment(base_config(tmp_path))
+        frame = experiment.run()
+        # 2 seeds x (zero_shot + best_of_n x 2 grid points) = 6 rows.
+        assert len(frame) == 6
+        assert set(frame["seed"]) == {42, 43}
+        assert (frame["evaluation_status"] == "pending").all()
+        assert (experiment.run_dir / "results.csv").exists()
+        assert (experiment.run_dir / "config.yaml").exists()
+        snapshot = yaml.safe_load((experiment.run_dir / "config.yaml").read_text())
+        assert snapshot["seed"] == 42
+
+    def test_method_error_becomes_row(self, tmp_path):
+        config = base_config(tmp_path, methods_to_run=["predefined"])
+        config["predefined"] = {}  # missing statement -> sentinel, not crash
+        frame = Experiment(config).run()
+        assert len(frame) == 2
+        assert frame["statement"].str.startswith("[ERROR").all()
+
+    def test_unknown_method_is_error_row_not_crash(self, tmp_path):
+        config = base_config(tmp_path, methods_to_run=["no_such_method"])
+        frame = Experiment(config).run()
+        assert (frame["error_message"].str.contains("Unknown method")).all()
+
+
+class TestEvaluator:
+    @pytest.fixture()
+    def evaluator(self):
+        backend = FakeBackend()
+        return StatementEvaluator(
+            backend, evaluation_model="fake-lm", judge_backend=backend
+        )
+
+    def test_metric_schema_matches_reference(self, evaluator):
+        metrics = evaluator.evaluate_statement("We should extend hours.", ISSUE, OPINIONS)
+        for name in OPINIONS:
+            assert f"avg_logprob_{name}" in metrics
+            assert f"utility_avg_logprob_{name}" in metrics
+            assert f"cosine_similarity_{name}" in metrics
+            assert f"perplexity_{name}" in metrics
+        for col in (
+            "egalitarian_welfare_cosine",
+            "utilitarian_welfare_cosine",
+            "log_nash_welfare_cosine",
+            "egalitarian_welfare_avg_prob",
+            "utility_egalitarian_welfare_logprob",
+            "egalitarian_welfare_perplexity",
+            "utilitarian_welfare_perplexity",
+            "log_nash_welfare_perplexity",
+        ):
+            assert col in metrics, col
+
+    def test_perplexity_egalitarian_is_max(self, evaluator):
+        metrics = evaluator.evaluate_statement("A test statement here.", ISSUE, OPINIONS)
+        ppls = [metrics[f"perplexity_{name}"] for name in OPINIONS]
+        assert metrics["egalitarian_welfare_perplexity"] == pytest.approx(max(ppls))
+        assert metrics["utilitarian_welfare_perplexity"] == pytest.approx(sum(ppls))
+
+    def test_perplexity_consistent_with_logprob(self, evaluator):
+        metrics = evaluator.evaluate_statement("A test statement here.", ISSUE, OPINIONS)
+        for name in OPINIONS:
+            assert metrics[f"perplexity_{name}"] == pytest.approx(
+                np.exp(-metrics[f"avg_logprob_{name}"])
+            )
+
+    def test_judge_scores(self, evaluator):
+        metrics = evaluator.evaluate_statement(
+            "A test statement here.", ISSUE, OPINIONS, include_llm_judge=True
+        )
+        for name in OPINIONS:
+            score = metrics[f"judge_score_{name}"]
+            assert score is None or 1 <= score <= 5
+        assert "egalitarian_welfare_judge_score" in metrics
+
+    def test_comparative_rankings(self, evaluator):
+        statements = {
+            "zero_shot": "Extend hours modestly.",
+            "best_of_n (n=3)": "Open late on weekends.",
+            "habermas_machine": "Pilot extended hours within budget.",
+        }
+        frame, reasoning, matrix = evaluator.evaluate_comparative_rankings(
+            statements, ISSUE, OPINIONS, seed=7
+        )
+        # method holds the base name, method_with_params the full key.
+        assert set(frame["method_with_params"]) == set(statements)
+        assert set(frame["method"]) == {"zero_shot", "best_of_n", "habermas_machine"}
+        assert frame.set_index("method_with_params").loc[
+            "best_of_n (n=3)", "param_n"
+        ] == 3
+        for name in OPINIONS:
+            ranks = frame[f"rank_{name}"].tolist()
+            assert sorted(ranks) == [1, 2, 3]  # valid permutation
+        assert frame["is_maximin_best"].sum() >= 1
+        assert frame["is_utilitarian_best"].sum() >= 1
+        assert len(reasoning) == len(OPINIONS)
+        assert matrix["methods"] == list(statements)
+
+    def test_results_file_layout(self, tmp_path, evaluator):
+        experiment = Experiment(base_config(tmp_path))
+        experiment.run()
+        frames = evaluator.evaluate_results_file(
+            str(experiment.run_dir / "results.csv")
+        )
+        assert set(frames) == {42, 43}
+        for seed_index in (0, 1):
+            csv = (
+                experiment.run_dir
+                / "evaluation"
+                / "fake-lm"
+                / f"seed_{seed_index}"
+                / "evaluation_results.csv"
+            )
+            assert csv.exists()
+            frame = pd.read_csv(csv)
+            assert len(frame) == 3
+            assert "method_with_params" in frame.columns
+            # Int params survive the CSV round-trip in identifiers.
+            keys = frame["method_with_params"].tolist()
+            assert any("(n=2)" in k or "n=2" in k for k in keys if "best_of_n" in k)
+
+
+class TestAggregation:
+    def test_aggregate_run_dir(self, tmp_path):
+        config = base_config(tmp_path)
+        experiment = Experiment(config)
+        experiment.run()
+        backend = experiment.backend
+        evaluator = StatementEvaluator(backend, evaluation_model="fake-lm")
+        evaluator.evaluate_results_file(str(experiment.run_dir / "results.csv"))
+
+        aggregated = aggregate_run_dir(str(experiment.run_dir))
+        assert aggregated is not None
+        out = experiment.run_dir / "evaluation" / "improved_aggregate"
+        assert (out / "aggregated_metrics.csv").exists()
+        assert (out / "aggregated_metrics_raw.csv").exists()
+        # Mean/std across the two seeds, model-prefixed.
+        cols = aggregated.columns
+        assert any(c.startswith("fake-lm_") and c.endswith("_mean") for c in cols)
+        assert any(c.endswith("_std") for c in cols)
+        # 3 method keys: zero_shot, best_of_n n=2, best_of_n n=3.
+        assert len(aggregated) == 3
+
+
+class TestFullPipelineCLI:
+    def test_run_pipeline(self, tmp_path):
+        from consensus_tpu.cli.run_experiment_with_eval import run_pipeline
+
+        config = base_config(tmp_path)
+        config_path = tmp_path / "config.yaml"
+        config_path.write_text(yaml.safe_dump(config))
+        run_dir = run_pipeline(str(config_path))
+        run_path = pytest.importorskip("pathlib").Path(run_dir)
+        assert (run_path / "results.csv").exists()
+        assert (
+            run_path / "evaluation" / "llm_judge" / "seed_0" / "ranking_results.csv"
+        ).exists()
+        assert (
+            run_path / "evaluation" / "improved_aggregate" / "aggregated_metrics.csv"
+        ).exists()
+        ranking = pd.read_csv(
+            run_path / "evaluation" / "llm_judge" / "seed_0" / "ranking_results.csv"
+        )
+        assert {"min_rank", "max_rank", "avg_rank", "is_maximin_best"} <= set(
+            ranking.columns
+        )
+
+
+class TestSweepDriver:
+    def test_find_config_files_filters(self, tmp_path):
+        from consensus_tpu.cli.run_sweep import find_config_files
+
+        for model in ("gemma", "llama"):
+            for scenario in (1, 2):
+                d = tmp_path / model / f"scenario_{scenario}"
+                d.mkdir(parents=True)
+                (d / "best_of_n.yaml").write_text("x: 1")
+                (d / "beam_search.yaml").write_text("x: 1")
+
+        all_configs = find_config_files(str(tmp_path))
+        assert len(all_configs) == 8
+        assert len(find_config_files(str(tmp_path), models=["gemma"])) == 4
+        assert len(find_config_files(str(tmp_path), scenarios=[2])) == 4
+        assert (
+            len(
+                find_config_files(
+                    str(tmp_path), models=["llama"], methods=["beam_search"]
+                )
+            )
+            == 2
+        )
