@@ -1,0 +1,219 @@
+"""Remote-API backend: byte-compatible fallback to hosted models.
+
+Preserves the reference's L1/L2 behaviour (src/utils.py): Together-style
+chat/raw completions for ``generate``, echo'd-prompt logprobs for ``score``,
+1-token completions for ``next_token_logprobs``, an embeddings endpoint for
+``embed``, a token-bucket rate limiter (src/experiment.py:26-62) and error
+sentinels instead of exceptions (src/utils.py:195-198, SURVEY §5.3).
+
+This environment is zero-egress, so construction is lazy and failure-
+tolerant: without the ``together``/``openai`` packages or keys every call
+returns error sentinels — the framework's decoders and pipeline behave
+exactly as the reference does when its client fails to initialize
+(src/utils.py:69-74 sets ``client = None`` and call sites degrade).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from consensus_tpu.backends.base import (
+    GenerationRequest,
+    GenerationResult,
+    NextTokenRequest,
+    ScoreRequest,
+    ScoreResult,
+    TokenCandidate,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class RateLimiter:
+    """Token-bucket limiter (reference APIRateLimiter, src/experiment.py:26-62)."""
+
+    def __init__(self, calls_per_second: float = 5.0):
+        self.rate = calls_per_second
+        self.capacity = max(1.0, calls_per_second)
+        self.tokens = self.capacity
+        self.updated = time.monotonic()
+        self._lock = threading.RLock()
+
+    def wait_for_token(self) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self.tokens = min(
+                    self.capacity, self.tokens + (now - self.updated) * self.rate
+                )
+                self.updated = now
+                if self.tokens >= 1.0:
+                    self.tokens -= 1.0
+                    return
+                needed = (1.0 - self.tokens) / self.rate
+            time.sleep(needed)
+
+
+class APIBackend:
+    name = "api"
+
+    def __init__(
+        self,
+        model: str = "google/gemma-2-9b-it",
+        embedding_model: str = "BAAI/bge-large-en-v1.5",
+        rate_limit: float = 5.0,
+        embed_dim: int = 1024,
+    ):
+        self.model = model
+        self.embedding_model = embedding_model
+        self.embed_dim = embed_dim
+        self.rate_limiter = RateLimiter(rate_limit)
+        self._client = None
+        try:  # pragma: no cover - zero-egress environment
+            from together import Together  # type: ignore
+
+            self._client = Together()
+        except Exception as exc:
+            logger.warning("APIBackend: client unavailable (%s); error sentinels", exc)
+
+    # -- protocol -----------------------------------------------------------
+
+    def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
+        return [self._generate_one(r) for r in requests]
+
+    def _generate_one(self, request: GenerationRequest) -> GenerationResult:
+        if self._client is None:
+            return GenerationResult(
+                text="[ERROR: API client not initialized]", finish_reason="error"
+            )
+        self.rate_limiter.wait_for_token()
+        try:  # pragma: no cover
+            if request.chat:
+                messages = []
+                if request.system_prompt:
+                    messages.append({"role": "system", "content": request.system_prompt})
+                messages.append({"role": "user", "content": request.user_prompt})
+                response = self._client.chat.completions.create(
+                    model=self.model,
+                    messages=messages,
+                    max_tokens=request.max_tokens,
+                    temperature=request.temperature,
+                    seed=request.seed,
+                    stop=list(request.stop) or None,
+                )
+                text = response.choices[0].message.content
+            else:
+                prompt = (
+                    f"{request.system_prompt}\n\n{request.user_prompt}"
+                    if request.system_prompt
+                    else request.user_prompt
+                )
+                response = self._client.completions.create(
+                    model=self.model,
+                    prompt=prompt,
+                    max_tokens=request.max_tokens,
+                    temperature=request.temperature,
+                    seed=request.seed,
+                    stop=list(request.stop) or None,
+                )
+                text = response.choices[0].text
+            return GenerationResult(text=text or "", finish_reason="stop")
+        except Exception as exc:
+            return GenerationResult(text=f"[ERROR: {exc}]", finish_reason="error")
+
+    def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+        return [self._score_one(r) for r in requests]
+
+    def _score_one(self, request: ScoreRequest) -> ScoreResult:
+        """Echo'd-prompt logprobs of the continuation span (the surface of
+        reference get_prompt_logprobs, src/utils.py:201-281)."""
+        if self._client is None:
+            return ScoreResult(tokens=(), logprobs=())
+        self.rate_limiter.wait_for_token()
+        try:  # pragma: no cover
+            prompt = (
+                f"{request.system_prompt}\n\n{request.context}{request.continuation}"
+                if request.system_prompt
+                else f"{request.context}{request.continuation}"
+            )
+            response = self._client.completions.create(
+                model=self.model,
+                prompt=prompt,
+                max_tokens=1,
+                logprobs=1,
+                echo=True,
+            )
+            tokens = response.prompt[0].logprobs.tokens
+            logprobs = response.prompt[0].logprobs.token_logprobs
+            # Keep only the continuation's trailing span by char budget.
+            span: List[str] = []
+            length = 0
+            for token, lp in zip(reversed(tokens), reversed(logprobs)):
+                if length >= len(request.continuation):
+                    break
+                span.append((token, lp))
+                length += len(token)
+            span.reverse()
+            return ScoreResult(
+                tokens=tuple(t for t, _ in span),
+                logprobs=tuple(float(lp) for _, lp in span if lp is not None),
+            )
+        except Exception as exc:
+            logger.warning("score failed: %s", exc)
+            return ScoreResult(tokens=(), logprobs=())
+
+    def next_token_logprobs(
+        self, requests: Sequence[NextTokenRequest]
+    ) -> List[List[TokenCandidate]]:
+        out: List[List[TokenCandidate]] = []
+        for request in requests:
+            candidates: List[TokenCandidate] = []
+            seen = set()
+            attempts = 0
+            # The reference's rejection-sampling pattern (beam_search.py:253-333):
+            # repeated 1-token completions with varied seeds until k distinct.
+            while len(candidates) < request.k and attempts < 3 * request.k:
+                attempts += 1
+                result = self._generate_one(
+                    GenerationRequest(
+                        user_prompt=request.user_prompt,
+                        system_prompt=request.system_prompt,
+                        max_tokens=1,
+                        temperature=request.temperature,
+                        seed=(request.seed or 0) + attempts,
+                        chat=request.chat,
+                    )
+                )
+                if not result.ok or not result.text:
+                    break
+                token = result.text
+                if token not in seen:
+                    seen.add(token)
+                    candidates.append(
+                        TokenCandidate(token=token, token_id=-1, logprob=0.0)
+                    )
+            out.append(candidates)
+        return out
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        if self._client is None:
+            return np.zeros((len(texts), self.embed_dim), np.float32)
+        vectors = []
+        for text in texts:  # pragma: no cover
+            self.rate_limiter.wait_for_token()
+            try:
+                response = self._client.embeddings.create(
+                    model=self.embedding_model, input=text
+                )
+                vectors.append(np.asarray(response.data[0].embedding, np.float32))
+            except Exception as exc:
+                logger.warning("embed failed: %s", exc)
+                vectors.append(np.zeros((self.embed_dim,), np.float32))
+        stacked = np.stack(vectors)
+        norms = np.linalg.norm(stacked, axis=1, keepdims=True)
+        return stacked / np.maximum(norms, 1e-12)

@@ -1,0 +1,371 @@
+"""On-device model backend: the TPU replacement for the reference's HTTP client.
+
+Where the reference sends every generate/score/sample/embed call to the
+Together API one request at a time (src/utils.py:70-525), this backend owns
+a resident JAX transformer (Gemma-2 / Llama-3 family or tiny test configs)
+and executes each protocol call as ONE padded, jitted device batch:
+
+* ``generate``  — left-padded batch prefill + ``lax.scan`` decode with
+  temperature/top-k, per-request logit bias sets, EOS ids, host-side stop-
+  string truncation (the ``generate_text`` surface, src/utils.py:77-198);
+* ``score``     — right-padded teacher-forced forward with the streaming
+  logsumexp scorer; returns continuation-token logprobs directly, replacing
+  the echo'd-prompt span extraction (src/utils.py:201-373, SURVEY §7.3);
+* ``next_token_logprobs`` — one forward for the exact next-token
+  distribution; top-k or seeded Gumbel-top-k gives k DISTINCT candidates,
+  replacing rejection-sampling-via-repeated-1-token-calls
+  (beam_search.py:199-333, mcts.py:165-247);
+* ``embed``     — masked mean-pooled final hidden states, L2-normalized
+  (the reference calls a separate embeddings API, src/utils.py:376-407).
+
+Shape discipline: prompts pad into power-of-two length buckets so XLA
+compiles a small, reused set of programs.  Multi-device: params are placed
+with the tensor-parallel layout and batches shard over ``data`` when a mesh
+is configured (consensus_tpu.parallel).
+
+Seed semantics (SURVEY §7.1): request seeds fold into the device PRNG key —
+runs are deterministic for identical batches, but not bitwise-comparable to
+the reference's server-side seeds.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_tpu.backends.base import (
+    GenerationRequest,
+    GenerationResult,
+    NextTokenRequest,
+    ScoreRequest,
+    ScoreResult,
+    TokenCandidate,
+)
+from consensus_tpu.models.config import ModelConfig, get_model_config
+from consensus_tpu.models.generate import generate_tokens, next_token_logits
+from consensus_tpu.models.tokenizer import get_tokenizer
+from consensus_tpu.models.transformer import (
+    forward,
+    init_params,
+    token_logprobs,
+    token_logprobs_streamed,
+)
+
+logger = logging.getLogger(__name__)
+
+#: Above this vocab size the streaming scorer replaces full-logit scoring.
+_STREAMED_VOCAB_THRESHOLD = 32_768
+
+
+def _bucket(n: int, minimum: int = 32) -> int:
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+class TPUBackend:
+    name = "tpu"
+
+    def __init__(
+        self,
+        model: str = "tiny-gemma2",
+        checkpoint: Optional[str] = None,
+        tokenizer: Optional[str] = None,
+        dtype: str = "bfloat16",
+        max_context: int = 1024,
+        base_seed: int = 0,
+        tp: int = 1,
+        params: Optional[Dict[str, Any]] = None,
+        config: Optional[ModelConfig] = None,
+    ):
+        self.config = config if config is not None else get_model_config(model)
+        self.model_name = model
+        family = "llama" if "llama" in self.config.name else "gemma"
+        self.tokenizer = get_tokenizer(tokenizer, family=family)
+        # A tokenizer-sized vocab keeps random-weight runs self-consistent.
+        if self.tokenizer.vocab_size != self.config.vocab_size and checkpoint is None:
+            import dataclasses
+
+            self.config = dataclasses.replace(
+                self.config, vocab_size=self.tokenizer.vocab_size
+            )
+        self.max_context = max_context
+        self.base_seed = base_seed
+
+        jax_dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype]
+        if params is not None:
+            self.params = params
+        elif checkpoint:
+            from consensus_tpu.models.loader import load_params
+
+            self.params = load_params(checkpoint, self.config, jax_dtype)
+        else:
+            logger.warning(
+                "TPUBackend: no checkpoint given — using RANDOM weights (%s). "
+                "Statements will be noise; timings/shapes are real.",
+                self.config.name,
+            )
+            self.params = init_params(
+                self.config, jax.random.PRNGKey(base_seed), jax_dtype
+            )
+
+        if tp > 1:
+            from consensus_tpu.parallel import make_mesh, shard_params
+
+            self.mesh_plan = make_mesh(tp=tp)
+            self.params = shard_params(self.params, self.mesh_plan.mesh)
+        else:
+            self.mesh_plan = None
+
+        self._bias_id_cache: Dict[str, Tuple[int, ...]] = {}
+        self.call_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _render_prompt(self, request) -> str:
+        if getattr(request, "chat", True):
+            return self.tokenizer.chat_prompt(
+                request.user_prompt, request.system_prompt
+            )
+        return self.tokenizer.raw_prompt(request.user_prompt, request.system_prompt)
+
+    def _left_pad_batch(
+        self, token_lists: List[List[int]]
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(tokens, valid) left-padded into a shared length bucket."""
+        longest = min(max(len(t) for t in token_lists), self.max_context)
+        width = min(_bucket(longest), self.max_context)
+        pad = self.tokenizer.pad_id
+        tokens = np.full((len(token_lists), width), pad, np.int32)
+        valid = np.zeros((len(token_lists), width), bool)
+        for row, ids in enumerate(token_lists):
+            ids = ids[-width:]  # keep the most recent context
+            tokens[row, width - len(ids):] = ids
+            valid[row, width - len(ids):] = True
+        return jnp.asarray(tokens), jnp.asarray(valid)
+
+    def _bias_vector(
+        self, bias_tokens: Sequence[str], bias_value: float
+    ) -> Optional[np.ndarray]:
+        if not bias_tokens:
+            return None
+        vector = np.zeros((self.config.vocab_size,), np.float32)
+        for text in bias_tokens:
+            key = text
+            if key not in self._bias_id_cache:
+                self._bias_id_cache[key] = tuple(
+                    self.tokenizer.token_ids_containing(text)
+                )
+            for token_id in self._bias_id_cache[key]:
+                vector[token_id] += bias_value
+        return vector
+
+    def _fold_seed(self, *parts) -> jax.Array:
+        # Stable across processes (Python's hash() is salted per process).
+        import hashlib
+
+        digest = hashlib.blake2b(repr(parts).encode(), digest_size=4).digest()
+        fold = int.from_bytes(digest, "big") % (2**31)
+        return jax.random.fold_in(jax.random.PRNGKey(self.base_seed), fold)
+
+    # -- generate ------------------------------------------------------------
+
+    def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
+        self.call_counts["generate"] += len(requests)
+        if not requests:
+            return []
+
+        token_lists = [
+            self.tokenizer.encode(self._render_prompt(r), add_bos=True)
+            for r in requests
+        ]
+        tokens, valid = self._left_pad_batch(token_lists)
+        max_new = _bucket(max(r.max_tokens for r in requests), minimum=16)
+        temperatures = jnp.asarray(
+            [r.temperature for r in requests], jnp.float32
+        )
+
+        # Per-ROW bias matrix: a request without bias_against_tokens must not
+        # inherit another request's bans.
+        logit_bias = None
+        if any(r.bias_against_tokens for r in requests):
+            matrix = np.zeros((len(requests), self.config.vocab_size), np.float32)
+            for row, request in enumerate(requests):
+                piece = self._bias_vector(
+                    request.bias_against_tokens, request.bias_value
+                )
+                if piece is not None:
+                    matrix[row] = piece
+            logit_bias = jnp.asarray(matrix)
+
+        key = self._fold_seed("generate", tuple(r.seed for r in requests))
+        out = generate_tokens(
+            self.params,
+            self.config,
+            tokens,
+            valid,
+            key,
+            max_new_tokens=max_new,
+            temperature=temperatures,
+            eos_ids=jnp.asarray(self.tokenizer.eos_ids, jnp.int32),
+            logit_bias=logit_bias,
+            pad_id=self.tokenizer.pad_id,
+        )
+        generated = np.asarray(out.tokens)
+        counts = np.asarray(out.num_generated)
+        hit_eos = np.asarray(out.hit_eos)
+
+        results = []
+        for row, request in enumerate(requests):
+            ids = [int(t) for t in generated[row, : counts[row]]]
+            ids = ids[: request.max_tokens]
+            text = self.tokenizer.decode(ids)
+            finish = "stop" if (hit_eos[row] or len(ids) < request.max_tokens) else "length"
+            for stop in request.stop:
+                idx = text.find(stop)
+                if idx >= 0:
+                    text = text[:idx]
+                    finish = "stop"
+            results.append(
+                GenerationResult(text=text, token_ids=tuple(ids), finish_reason=finish)
+            )
+        return results
+
+    # -- score ---------------------------------------------------------------
+
+    def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+        self.call_counts["score"] += len(requests)
+        if not requests:
+            return []
+
+        rows = []
+        spans = []  # (context_len, continuation_len) per row
+        for request in requests:
+            prefix = (
+                f"{request.system_prompt}\n\n{request.context}"
+                if request.system_prompt
+                else request.context
+            )
+            if request.chat:
+                prefix = self.tokenizer.chat_prompt(request.context, request.system_prompt)
+            context_ids = self.tokenizer.encode(prefix, add_bos=True)
+            continuation_ids = self.tokenizer.encode(request.continuation)
+            rows.append(context_ids + continuation_ids)
+            spans.append((len(context_ids), len(continuation_ids)))
+
+        longest = min(max(len(r) for r in rows), self.max_context)
+        width = min(_bucket(longest), self.max_context)
+        pad = self.tokenizer.pad_id
+        tokens = np.full((len(rows), width), pad, np.int32)
+        valid = np.zeros((len(rows), width), bool)
+        for i, ids in enumerate(rows):
+            if len(ids) > width:
+                # Drop the OLDEST context so the scored continuation (at the
+                # end) survives; record how much context was cut.
+                cut = len(ids) - width
+                ids = ids[cut:]
+                ctx_len, cont_len = spans[i]
+                spans[i] = (max(ctx_len - cut, 0), cont_len)
+            tokens[i, : len(ids)] = ids  # RIGHT-padded for scoring
+            valid[i, : len(ids)] = True
+
+        scorer = (
+            token_logprobs_streamed
+            if self.config.vocab_size > _STREAMED_VOCAB_THRESHOLD
+            else token_logprobs
+        )
+        logprobs = np.asarray(
+            scorer(self.params, self.config, jnp.asarray(tokens), jnp.asarray(valid))
+        )
+
+        results = []
+        for i, (request, (ctx_len, cont_len)) in enumerate(zip(requests, spans)):
+            end = min(ctx_len + cont_len, width)
+            span_lp = logprobs[i, ctx_len:end]
+            span_ids = tokens[i, ctx_len:end]
+            results.append(
+                ScoreResult(
+                    tokens=tuple(self.tokenizer.token_str(t) for t in span_ids),
+                    logprobs=tuple(float(v) for v in span_lp),
+                )
+            )
+        return results
+
+    # -- next-token distribution ----------------------------------------------
+
+    def next_token_logprobs(
+        self, requests: Sequence[NextTokenRequest]
+    ) -> List[List[TokenCandidate]]:
+        self.call_counts["next_token"] += len(requests)
+        if not requests:
+            return []
+
+        token_lists = [
+            self.tokenizer.encode(self._render_prompt(r), add_bos=True)
+            for r in requests
+        ]
+        tokens, valid = self._left_pad_batch(token_lists)
+        logits = np.asarray(
+            next_token_logits(self.params, self.config, tokens, valid)
+        )  # (B, V) float32 on host: exact, per-request selection below
+
+        out: List[List[TokenCandidate]] = []
+        for row, request in enumerate(requests):
+            row_logits = logits[row].astype(np.float64)
+            bias = self._bias_vector(request.bias_against_tokens, request.bias_value)
+            if bias is not None:
+                row_logits = row_logits + bias
+            shifted = row_logits - row_logits.max()
+            logprobs = shifted - np.log(np.exp(shifted).sum())
+            k = min(request.k, len(logprobs))
+            if request.mode == "topk" or request.temperature <= 0:
+                top = np.argpartition(-logprobs, k - 1)[:k]
+            else:
+                rng = np.random.default_rng(
+                    (self.base_seed * 1_000_003 + (request.seed or 0)) % (2**63)
+                )
+                gumbel = rng.gumbel(size=logprobs.shape)
+                scores = logprobs / max(request.temperature, 1e-6) + gumbel
+                top = np.argpartition(-scores, k - 1)[:k]
+            top = top[np.argsort(-logprobs[top])]
+            out.append(
+                [
+                    TokenCandidate(
+                        token=self.tokenizer.token_str(int(t)),
+                        token_id=int(t),
+                        logprob=float(logprobs[t]),
+                    )
+                    for t in top
+                ]
+            )
+        return out
+
+    # -- embeddings ------------------------------------------------------------
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        self.call_counts["embed"] += len(texts)
+        token_lists = [self.tokenizer.encode(t, add_bos=True) for t in texts]
+        tokens, valid = self._left_pad_batch(token_lists)
+        hidden = np.asarray(
+            _embed_forward(self.params, self.config, tokens, valid)
+        )
+        norms = np.linalg.norm(hidden, axis=1, keepdims=True)
+        return hidden / np.maximum(norms, 1e-12)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def _embed_forward(params, config: ModelConfig, tokens, valid):
+    """Masked mean-pool of final hidden states -> (B, D) float32."""
+    positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+    hidden, _ = forward(params, config, tokens, positions, valid, return_hidden=True)
+    mask = valid[..., None].astype(jnp.float32)
+    pooled = (hidden.astype(jnp.float32) * mask).sum(1) / jnp.maximum(
+        mask.sum(1), 1.0
+    )
+    return pooled

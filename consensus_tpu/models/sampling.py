@@ -54,8 +54,11 @@ def sample_tokens(
 
     filtered = _top_k_filter(logits, top_k)
     filtered = _top_p_filter(filtered, top_p)
-    temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
-    sampled = jax.random.categorical(key, filtered / temp, axis=-1)
+    temp = jnp.asarray(temperature, jnp.float32)
+    if temp.ndim == 1:  # per-row temperatures (B,) -> broadcast over vocab
+        temp = temp[:, None]
+    safe_temp = jnp.maximum(temp, 1e-6)
+    sampled = jax.random.categorical(key, filtered / safe_temp, axis=-1)
 
-    use_greedy = jnp.asarray(temperature, jnp.float32) <= 0.0
+    use_greedy = jnp.any(temp <= 0.0, axis=-1) if temp.ndim else temp <= 0.0
     return jnp.where(use_greedy, greedy, sampled).astype(jnp.int32)

@@ -1,0 +1,178 @@
+"""TPUBackend protocol tests on a tiny random-weight model (CPU devices).
+
+Random weights make statements noise, but every protocol property —
+shapes, determinism, logprob validity, batching, EOS/stop handling —
+is exactly what production runs rely on.
+"""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.base import (
+    GenerationRequest,
+    NextTokenRequest,
+    ScoreRequest,
+)
+from consensus_tpu.backends.tpu import TPUBackend
+
+ISSUE = "Should the town build a new playground?"
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return TPUBackend(model="tiny-gemma2", max_context=256, base_seed=0)
+
+
+class TestGenerate:
+    def test_batch_generation(self, backend):
+        requests = [
+            GenerationRequest(user_prompt=f"Prompt {i}", max_tokens=8, seed=i)
+            for i in range(3)
+        ]
+        results = backend.generate(requests)
+        assert len(results) == 3
+        for result in results:
+            assert result.finish_reason in ("stop", "length")
+            assert len(result.token_ids) <= 8
+
+    def test_deterministic_for_same_batch(self, backend):
+        requests = [GenerationRequest(user_prompt="Same prompt", max_tokens=6, seed=1)]
+        r1 = backend.generate(requests)[0]
+        r2 = backend.generate(requests)[0]
+        assert r1.text == r2.text
+
+    def test_stop_string_truncates(self, backend):
+        request = GenerationRequest(user_prompt="Hi", max_tokens=6, seed=0)
+        full = backend.generate([request])[0]
+        if len(full.text) > 1:
+            stop_char = full.text[1]
+            stopped = backend.generate(
+                [GenerationRequest(user_prompt="Hi", max_tokens=6, seed=0,
+                                   stop=(stop_char,))]
+            )[0]
+            assert stop_char not in stopped.text
+
+    def test_greedy_at_zero_temperature(self, backend):
+        requests = [
+            GenerationRequest(user_prompt="Greedy", max_tokens=5, temperature=0.0,
+                              seed=s)
+            for s in (1, 2)
+        ]
+        results = backend.generate(requests)
+        assert results[0].text == results[1].text  # greedy ignores seed
+
+
+class TestScore:
+    def test_continuation_logprobs_only(self, backend):
+        result = backend.score(
+            [ScoreRequest(context="The town meeting", continuation=" agreed today")]
+        )[0]
+        assert result.ok
+        assert all(lp <= 0.0 for lp in result.logprobs)
+        # Tokens decode back to the continuation text.
+        assert "".join(result.tokens).strip().startswith("agreed")
+
+    def test_batch_matches_single(self, backend):
+        requests = [
+            ScoreRequest(context="Alpha beta", continuation=" gamma"),
+            ScoreRequest(context="One two", continuation=" three four"),
+        ]
+        batched = backend.score(requests)
+        singles = [backend.score([r])[0] for r in requests]
+        for b, s in zip(batched, singles):
+            np.testing.assert_allclose(b.logprobs, s.logprobs, atol=1e-3)
+
+    def test_mean_and_total(self, backend):
+        result = backend.score(
+            [ScoreRequest(context="ctx", continuation=" something longer here")]
+        )[0]
+        assert result.mean() == pytest.approx(np.mean(result.logprobs))
+        assert result.total() == pytest.approx(np.sum(result.logprobs))
+
+
+class TestNextToken:
+    def test_topk_distinct_sorted(self, backend):
+        candidates = backend.next_token_logprobs(
+            [NextTokenRequest(user_prompt="Next", k=5, mode="topk")]
+        )[0]
+        assert len(candidates) == 5
+        ids = [c.token_id for c in candidates]
+        assert len(set(ids)) == 5
+        lps = [c.logprob for c in candidates]
+        assert lps == sorted(lps, reverse=True)
+
+    def test_sample_mode_seed_dependence(self, backend):
+        a = backend.next_token_logprobs(
+            [NextTokenRequest(user_prompt="Next", k=4, mode="sample", seed=1)]
+        )[0]
+        b = backend.next_token_logprobs(
+            [NextTokenRequest(user_prompt="Next", k=4, mode="sample", seed=1)]
+        )[0]
+        c = backend.next_token_logprobs(
+            [NextTokenRequest(user_prompt="Next", k=4, mode="sample", seed=2)]
+        )[0]
+        assert [x.token_id for x in a] == [x.token_id for x in b]
+        assert any(
+            x.token_id != y.token_id for x, y in zip(a, c)
+        ) or len(a) != len(c)
+
+    def test_bias_suppresses_tokens(self, backend):
+        top = backend.next_token_logprobs(
+            [NextTokenRequest(user_prompt="Bias test", k=3, mode="topk")]
+        )[0]
+        banned = top[0].token
+        if banned.strip():
+            rebiased = backend.next_token_logprobs(
+                [
+                    NextTokenRequest(
+                        user_prompt="Bias test", k=3, mode="topk",
+                        bias_against_tokens=(banned,),
+                    )
+                ]
+            )[0]
+            assert all(banned not in c.token for c in rebiased)
+
+
+class TestEmbed:
+    def test_unit_norm_and_shape(self, backend):
+        vectors = backend.embed(["hello world", "completely different text"])
+        assert vectors.shape[0] == 2
+        np.testing.assert_allclose(
+            np.linalg.norm(vectors, axis=1), [1.0, 1.0], atol=1e-5
+        )
+
+    def test_identical_texts_identical_vectors(self, backend):
+        vectors = backend.embed(["same text", "same text"])
+        np.testing.assert_allclose(vectors[0], vectors[1], atol=1e-6)
+
+
+class TestDecoderIntegration:
+    def test_best_of_n_runs_on_tpu_backend(self, backend):
+        from consensus_tpu.methods import get_method_generator
+
+        gen = get_method_generator(
+            "best_of_n", backend, {"n": 2, "max_tokens": 6, "seed": 3}
+        )
+        statement = gen.generate_statement(
+            ISSUE, {"A": "Yes, kids need it.", "B": "Too expensive."}
+        )
+        assert isinstance(statement, str)
+
+    def test_experiment_with_tpu_backend(self, backend, tmp_path):
+        from consensus_tpu.experiment import Experiment
+
+        config = {
+            "experiment_name": "tpu_smoke",
+            "seed": 1,
+            "num_seeds": 1,
+            "scenario": {
+                "issue": ISSUE,
+                "agent_opinions": {"A": "Build it.", "B": "Save the money."},
+            },
+            "methods_to_run": ["zero_shot"],
+            "zero_shot": {"max_tokens": 6},
+            "output_dir": str(tmp_path),
+        }
+        frame = Experiment(config, backend=backend).run()
+        assert len(frame) == 1
+        assert frame["error_message"].iloc[0] == ""
