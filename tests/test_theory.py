@@ -1,0 +1,97 @@
+"""Theory-module tests: utilities, Frank–Wolfe, LPs, rollout equivalence."""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.theory import (
+    compute_utilities,
+    egalitarian_lottery,
+    enumerate_leaves,
+    generate_params,
+    induced_policy_rollout,
+    max_coalition_improvement,
+    nash_welfare_lottery,
+    nash_welfare_value,
+)
+
+B, L, D, N = 2, 3, 4, 3
+
+
+@pytest.fixture(scope="module")
+def utilities():
+    v, w = generate_params(B, L, D, N, seed=5)
+    U, leaves = compute_utilities(v, w, rho=2.0)
+    return U, leaves
+
+
+def test_enumerate_leaves_shape_and_order():
+    leaves = np.asarray(enumerate_leaves(2, 3))
+    assert leaves.shape == (8, 3)
+    assert leaves[0].tolist() == [0, 0, 0]
+    assert leaves[-1].tolist() == [1, 1, 1]
+    # Row index equals the base-B digit interpretation (rollout relies on it).
+    for i, row in enumerate(leaves):
+        assert i == int("".join(map(str, row)), 2)
+
+
+def test_utilities_positive_normalized(utilities):
+    U, _ = utilities
+    assert U.shape == (N, B**L)
+    assert np.all(U > 0)
+    assert np.allclose(U.max(axis=1), 1.0 + 1e-300)  # per-agent max-stabilized
+
+
+def test_utilities_are_products_of_step_probs():
+    """At rho=0 every step policy is uniform, so all leaves tie."""
+    v, w = generate_params(B, L, D, N, seed=1)
+    U, _ = compute_utilities(v, w, rho=0.0)
+    assert np.allclose(U, U[:, :1])
+
+
+def test_nash_lottery_on_simplex(utilities):
+    U, _ = utilities
+    p = nash_welfare_lottery(U)
+    assert p.shape == (B**L,)
+    assert np.all(p >= -1e-12)
+    assert np.isclose(p.sum(), 1.0, atol=1e-6)
+
+
+def test_nash_lottery_beats_baselines(utilities):
+    U, _ = utilities
+    p = nash_welfare_lottery(U)
+    m = U.shape[1]
+    assert nash_welfare_value(U, p) >= nash_welfare_value(U, np.ones(m) / m) - 1e-9
+    best_leaf = np.zeros(m)
+    best_leaf[int(np.argmax(U.sum(0)))] = 1.0
+    assert nash_welfare_value(U, p) >= nash_welfare_value(U, best_leaf) - 1e-9
+
+
+def test_egalitarian_lottery_maximin(utilities):
+    U, _ = utilities
+    p = egalitarian_lottery(U)
+    assert np.isclose(p.sum(), 1.0, atol=1e-6)
+    # Its min utility beats the uniform lottery's min utility.
+    assert (U @ p).min() >= (U @ (np.ones(U.shape[1]) / U.shape[1])).min() - 1e-9
+
+
+def test_nash_is_not_blockable(utilities):
+    """The paper's claim: NW lottery alpha stays ~1 (in the core)."""
+    U, _ = utilities
+    alpha = max_coalition_improvement(U, nash_welfare_lottery(U))
+    assert alpha <= 1.0 + 1e-4
+
+
+def test_bad_lottery_is_blockable(utilities):
+    """A degenerate lottery on the worst leaf should be blockable."""
+    U, _ = utilities
+    worst = np.zeros(U.shape[1])
+    worst[int(np.argmin(U.sum(0)))] = 1.0
+    alpha = max_coalition_improvement(U, worst)
+    assert alpha > 1.0
+
+
+def test_induced_rollout_matches_lottery(utilities):
+    U, _ = utilities
+    p = nash_welfare_lottery(U)
+    _, tv = induced_policy_rollout(p, B, L, num_samples=50_000, seed=3)
+    assert tv < 0.03  # sampling noise only
