@@ -102,6 +102,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"Re-evaluated: {args.results_dir}")
         return 0
     if args.statements_text:
+        if not args.opinions:
+            parser.error("--statements-text requires --opinions")
         frame = evaluate_adhoc_statements(
             args.statements_text,
             args.issue,

@@ -67,11 +67,16 @@ def run_pipeline(
             # aliases judge "o3" -> gpt-4.1 inside its OpenAI path,
             # src/evaluation.py:447-462; ours is the backend's concern).
             judge_options.setdefault("model", llm_judge_model)
-        judge_backend = (
-            get_backend(config["judge_backend"], **judge_options)
-            if config.get("judge_backend")
-            else backend
-        )
+        if config.get("judge_backend"):
+            judge_backend = get_backend(config["judge_backend"], **judge_options)
+        else:
+            if llm_judge_model:
+                logger.warning(
+                    "--llm-judge-model=%s ignored: config has no judge_backend "
+                    "key, so the generation backend judges with its own model",
+                    llm_judge_model,
+                )
+            judge_backend = backend
         evaluator = StatementEvaluator(
             backend, judge_backend=judge_backend, llm_judge_model=llm_judge_model
         )
@@ -79,6 +84,7 @@ def run_pipeline(
             subset = results[
                 (results["seed"] == seed)
                 & (results["statement"].astype(str).str.strip() != "")
+                & ~results["statement"].astype(str).str.lstrip().str.startswith("[ERROR")
                 & (results["error_message"].fillna("").astype(str).str.strip() == "")
             ]
             method_statements = {}
@@ -110,8 +116,25 @@ def run_pipeline(
     models = evaluation_models or experiment.evaluation_models or [
         config.get("models", {}).get("generation_model", "model")
     ]
+    # Optional per-model backend routing: evaluation_backends:
+    #   {model_name: {name: tpu|fake|api, ...options}}.  Without it every
+    # evaluation model shares the resident generation backend (same scores
+    # under different directory names) — warn so that's a choice, not a trap.
+    eval_backends = config.get("evaluation_backends") or {}
+    if len(models) > 1 and not eval_backends:
+        logger.warning(
+            "%d evaluation models share ONE resident backend — their metrics "
+            "will be identical; set config.evaluation_backends to route "
+            "models to distinct backends",
+            len(models),
+        )
     for model in models:
-        evaluator = StatementEvaluator(backend, evaluation_model=model)
+        model_backend = (
+            get_backend(dict(eval_backends[model]))
+            if model in eval_backends
+            else backend
+        )
+        evaluator = StatementEvaluator(model_backend, evaluation_model=model)
         evaluator.evaluate_results_file(str(run_dir / "results.csv"), config=config)
         logger.info("Evaluated with %s", sanitize_model_name(model))
 

@@ -321,6 +321,10 @@ class StatementEvaluator:
             statement = row.get("statement", "")
             if not isinstance(statement, str) or not statement.strip():
                 continue
+            # Error-sentinel statements are excluded like the reference's
+            # 'statement != "ERROR"' filters (src/evaluation.py:665, :1112).
+            if statement.lstrip().startswith("[ERROR"):
+                continue
             error = row.get("error_message")
             if not pd.isna(error) and str(error).strip():
                 continue
