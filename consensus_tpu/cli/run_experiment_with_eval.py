@@ -138,9 +138,17 @@ def run_pipeline(
         evaluator.evaluate_results_file(str(run_dir / "results.csv"), config=config)
         logger.info("Evaluated with %s", sanitize_model_name(model))
 
-    # ---- Phase 3: aggregation -----------------------------------------
+    # ---- Phase 3: aggregation (improved, basic fallback) --------------
     logger.info("=== Phase 3: aggregation ===")
-    aggregate_run_dir(str(run_dir))
+    try:
+        aggregate_run_dir(str(run_dir))
+    except Exception:
+        # Reference falls back to the basic aggregator when the improved
+        # one fails (run_experiment_with_eval.py:404-459).
+        logger.exception("Improved aggregation failed; running basic fallback")
+        from consensus_tpu.aggregation import aggregate_run_dir_basic
+
+        aggregate_run_dir_basic(str(run_dir))
     return str(run_dir)
 
 

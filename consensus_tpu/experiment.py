@@ -38,6 +38,7 @@ import yaml
 from consensus_tpu.backends import get_backend
 from consensus_tpu.backends.base import Backend
 from consensus_tpu.methods import get_method_generator
+from consensus_tpu.utils.tracing import get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -136,7 +137,10 @@ class Experiment:
             generator = get_method_generator(
                 method, self.backend, run_config, self.generation_model
             )
-            statement = generator.generate_statement(self.issue, self.agent_opinions)
+            with get_tracer().span(f"generate/{method}"):
+                statement = generator.generate_statement(
+                    self.issue, self.agent_opinions
+                )
             row["statement"] = statement
             if generator.pre_brushup_statement is not None and run_config.get(
                 "brushup", False
@@ -163,5 +167,6 @@ class Experiment:
         rest = sorted(c for c in frame.columns if c not in lead)
         frame = frame[lead + rest]
         frame.to_csv(self.run_dir / "results.csv", index=False)
+        get_tracer().write(self.run_dir / "timing.json")
         logger.info("Saved %d rows to %s", len(frame), self.run_dir / "results.csv")
         return frame

@@ -45,6 +45,9 @@ class ModelConfig:
     use_post_norms: bool = True
     # RMSNorm scale convention: "gemma" computes x * (1 + w), "llama" x * w.
     rmsnorm_style: str = "gemma"
+    # Use the pallas flash-attention kernel on the no-cache (teacher-forced
+    # scoring) path instead of materializing (B, H, S, S) logits.
+    use_flash_attention: bool = False
 
     @property
     def q_scale(self) -> float:

@@ -248,13 +248,46 @@ def forward(
         keys_r = jnp.repeat(keys, reps, axis=2)  # (B, T, H, hd)
         values_r = jnp.repeat(values, reps, axis=2)
 
-        logits = jnp.einsum("bshd,bthd->bhst", q, keys_r).astype(jnp.float32)
-        logits = logits * c.q_scale
-        logits = _softcap(logits, c.attn_softcap)
-        mask = jnp.where(is_local, local_mask, global_mask)
-        logits = jnp.where(mask, logits, MASK_FILL)
-        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhst,bthd->bshd", weights, values_r)
+        if c.use_flash_attention and cache is None:
+            # Pallas blockwise kernel: no (B, H, S, S) logits in HBM.  The
+            # kernel's masking model is right-padded prefix-valid rows (the
+            # scoring layout), so validity reduces to per-row lengths.
+            # ``is_local`` is a traced scan input, so window selection is a
+            # lax.cond between two statically-windowed kernel calls.
+            from consensus_tpu.ops.flash_attention import flash_attention
+
+            interp = jax.default_backend() == "cpu"
+            lengths = jnp.sum(valid.astype(jnp.int32), axis=1)
+
+            def call_flash(window):
+                def fn(operands):
+                    qq, kk, vv = operands
+                    return flash_attention(
+                        qq, kk, vv, lengths,
+                        scale=c.q_scale, softcap=c.attn_softcap,
+                        window=window, causal=True, interpret=interp,
+                    )
+                return fn
+
+            operands = (q, keys_r, values_r)
+            if c.sliding_window is None:
+                attn = call_flash(None)(operands)
+            else:
+                attn = jax.lax.cond(
+                    is_local,
+                    call_flash(c.sliding_window),
+                    call_flash(None),
+                    operands,
+                )
+            attn = attn.astype(x.dtype)
+        else:
+            logits = jnp.einsum("bshd,bthd->bhst", q, keys_r).astype(jnp.float32)
+            logits = logits * c.q_scale
+            logits = _softcap(logits, c.attn_softcap)
+            mask = jnp.where(is_local, local_mask, global_mask)
+            logits = jnp.where(mask, logits, MASK_FILL)
+            weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bhst,bthd->bshd", weights, values_r)
         attn = attn.reshape(batch, span, h * hd) @ lp["wo"]
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)

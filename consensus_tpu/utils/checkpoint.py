@@ -1,0 +1,53 @@
+"""Checkpoint/resume for model params and train state (orbax).
+
+The reference's only "resume" is its phase-split artifact contract —
+results.csv persists and evaluation re-runs post-hoc (SURVEY §5.4); it has
+no model state to checkpoint because it owns no model.  This framework
+does: fine-tuned params (consensus_tpu.parallel.train) and converted HF
+checkpoints persist via orbax so sweeps don't re-convert, and restores
+place leaves directly onto a sharded layout when a mesh plan is given.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Dict, Optional
+
+import jax
+
+
+def save_params(path: str, params: Dict[str, Any]) -> None:
+    """Write a param pytree to an orbax checkpoint directory."""
+    import orbax.checkpoint as ocp
+
+    target = pathlib.Path(path).absolute()
+    with ocp.StandardCheckpointer() as checkpointer:
+        checkpointer.save(target, params, force=True)
+
+
+def restore_params(
+    path: str,
+    template: Optional[Dict[str, Any]] = None,
+    shardings: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Read a param pytree back; with ``shardings`` leaves restore directly
+    into the sharded layout (no host round-trip through replicated arrays)."""
+    import orbax.checkpoint as ocp
+
+    source = pathlib.Path(path).absolute()
+    with ocp.StandardCheckpointer() as checkpointer:
+        if template is None:
+            return checkpointer.restore(source)
+        if shardings is not None:
+            template = jax.tree.map(
+                lambda leaf, sharding: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=sharding
+                ),
+                template,
+                shardings,
+            )
+        else:
+            template = jax.tree.map(
+                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype), template
+            )
+        return checkpointer.restore(source, template)

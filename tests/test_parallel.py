@@ -114,3 +114,31 @@ def test_entry_traces_abstractly():
 
     out = jax.eval_shape(score_step, params_shape, tokens, valid)
     assert out.shape == (4, 128, config.vocab_size)
+
+
+def test_checkpoint_roundtrip(tmp_path, tiny_config):
+    from consensus_tpu.utils.checkpoint import restore_params, save_params
+
+    params = init_params(tiny_config, jax.random.PRNGKey(3))
+    save_params(str(tmp_path / "ckpt"), params)
+    restored = restore_params(str(tmp_path / "ckpt"), template=params)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_restore_sharded(tmp_path, tiny_config):
+    from consensus_tpu.parallel.mesh import param_shardings
+    from consensus_tpu.utils.checkpoint import restore_params, save_params
+
+    params = init_params(tiny_config, jax.random.PRNGKey(4))
+    save_params(str(tmp_path / "ckpt"), params)
+    plan = make_mesh(tp=2)
+    shardings = param_shardings(params, plan.mesh)
+    restored = restore_params(
+        str(tmp_path / "ckpt"), template=params, shardings=shardings
+    )
+    wq = restored["layers"]["wq"]
+    assert wq.sharding.spec[-1] == "model"
+    np.testing.assert_allclose(
+        np.asarray(wq), np.asarray(params["layers"]["wq"])
+    )

@@ -240,3 +240,57 @@ class TestSweepDriver:
             )
             == 2
         )
+
+
+class TestBasicAggregation:
+    def test_basic_layout(self, tmp_path):
+        from consensus_tpu.aggregation import aggregate_run_dir_basic
+
+        config = base_config(tmp_path)
+        experiment = Experiment(config)
+        experiment.run()
+        evaluator = StatementEvaluator(
+            experiment.backend, evaluation_model="fake-lm"
+        )
+        evaluator.evaluate_results_file(str(experiment.run_dir / "results.csv"))
+
+        combined = aggregate_run_dir_basic(str(experiment.run_dir))
+        assert combined is not None
+        out = experiment.run_dir / "evaluation" / "aggregate"
+        assert (out / "fake-lm" / "aggregated_metrics.csv").exists()
+        assert (out / "combined_metrics.csv").exists()
+        assert (out / "simplified_metrics.csv").exists()
+        simplified = pd.read_csv(out / "simplified_metrics.csv")
+        assert "method_with_params" in simplified.columns
+        assert any(
+            "egalitarian_welfare_perplexity_mean" in c for c in simplified.columns
+        )
+
+
+class TestTracing:
+    def test_spans_accumulate_and_write(self, tmp_path):
+        from consensus_tpu.utils.tracing import Tracer
+
+        tracer = Tracer()
+        with tracer.span("phase/a"):
+            pass
+        with tracer.span("phase/a"):
+            pass
+        with tracer.span("phase/b"):
+            pass
+        summary = tracer.summary()
+        assert summary["phase/a"]["count"] == 2
+        assert summary["phase/b"]["count"] == 1
+        tracer.write(tmp_path / "timing.json")
+        import json
+
+        loaded = json.loads((tmp_path / "timing.json").read_text())
+        assert set(loaded) == {"phase/a", "phase/b"}
+
+    def test_experiment_writes_timing(self, tmp_path):
+        experiment = Experiment(base_config(tmp_path, num_seeds=1))
+        experiment.run()
+        import json
+
+        timing = json.loads((experiment.run_dir / "timing.json").read_text())
+        assert any(k.startswith("generate/") for k in timing)
