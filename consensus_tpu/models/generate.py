@@ -75,7 +75,11 @@ def generate_tokens(
 
     def step(carry, i):
         next_logits, cache, done, key, cur_pos = carry
-        key, sub = jax.random.split(key)
+        if key.ndim == 2:  # per-row keys: rows draw independently
+            pairs = jax.vmap(jax.random.split)(key)  # (B, 2, 2)
+            key, sub = pairs[:, 0], pairs[:, 1]
+        else:
+            key, sub = jax.random.split(key)
         token = sample_tokens(
             sub, next_logits, temperature=temperature, top_k=top_k, top_p=top_p,
             logit_bias=logit_bias,
@@ -108,6 +112,52 @@ def generate_tokens(
     hit_eos = num_generated < max_new_tokens
     tokens = jnp.where(emitted, tokens, pad_id)
     return GenerateOutput(tokens=tokens, num_generated=num_generated, hit_eos=hit_eos)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "k", "with_gumbel"))
+def next_token_topk(
+    params,
+    config: ModelConfig,
+    prompt_tokens: jax.Array,  # (B, S) LEFT-padded
+    prompt_valid: jax.Array,  # (B, S) bool
+    keys: jax.Array,  # (B, 2) per-row PRNG keys (Gumbel perturbation)
+    k: int,
+    temperature: jax.Array,  # (B,) float32
+    use_gumbel: jax.Array,  # (B,) bool — False rows take deterministic top-k
+    bias_table: Optional[jax.Array] = None,  # (U, V) unique bias vectors
+    bias_index: Optional[jax.Array] = None,  # (B,) int32 row -> table index
+    with_gumbel: bool = True,  # static: skip (B, V) noise for pure-topk batches
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k next-token candidates per row, selected ON DEVICE.
+
+    Returns (ids (B, k) int32, logprobs (B, k) float32) — the host transfer
+    is O(B·k), never the (B, 256k) logit matrix (VERDICT r1 #6; replaces the
+    reference's rejection sampling, beam_search.py:199-333).
+
+    Selection: scores = logprobs / max(temp, eps) + gumbel·use_gumbel; for
+    deterministic rows the Gumbel term is zeroed and positive-temperature
+    scaling is order-preserving, so top-k by score == top-k by logprob.
+    Results come back in SCORE order (Gumbel-top-k = sampling without
+    replacement, so a caller wanting fewer candidates takes a prefix);
+    logprobs are the true (biased, untempered) log-softmax values.
+    """
+    positions = left_pad_positions(prompt_valid)
+    hidden, _ = forward(
+        params, config, prompt_tokens, positions, prompt_valid, return_hidden=True
+    )
+    logits = project_logits(params, config, hidden[:, -1, :])  # (B, V) f32
+    if bias_table is not None:
+        logits = logits + bias_table[bias_index]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scores = logprobs / temp
+    if with_gumbel:
+        gumbel = jax.vmap(lambda kk: jax.random.gumbel(kk, (logits.shape[-1],)))(keys)
+        scores = scores + gumbel * use_gumbel[:, None].astype(jnp.float32)
+    _, ids = jax.lax.top_k(scores, k)  # (B, k)
+    picked = jnp.take_along_axis(logprobs, ids, axis=-1)
+    return ids.astype(jnp.int32), picked
 
 
 @functools.partial(jax.jit, static_argnames=("config",))

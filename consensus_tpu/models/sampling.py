@@ -38,14 +38,19 @@ def _top_p_filter(logits: jax.Array, p: float) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
 def sample_tokens(
-    key: jax.Array,
+    key: jax.Array,  # single key (2,) or per-row keys (B, 2)
     logits: jax.Array,  # (B, V) float32
     temperature: float | jax.Array = 1.0,
     top_k: int = 0,
     top_p: float = 1.0,
     logit_bias: Optional[jax.Array] = None,  # (V,) or (B, V) additive
 ) -> jax.Array:
-    """Sample one token id per row; temperature<=0 means greedy argmax."""
+    """Sample one token id per row; temperature<=0 means greedy argmax.
+
+    With per-row keys (B, 2), each row's draw depends only on its own key —
+    a request's output is then independent of batch composition, matching
+    the reference's per-request seed semantics (SURVEY §7.4).
+    """
     logits = logits.astype(jnp.float32)
     if logit_bias is not None:
         logits = logits + logit_bias
@@ -58,7 +63,11 @@ def sample_tokens(
     if temp.ndim == 1:  # per-row temperatures (B,) -> broadcast over vocab
         temp = temp[:, None]
     safe_temp = jnp.maximum(temp, 1e-6)
-    sampled = jax.random.categorical(key, filtered / safe_temp, axis=-1)
+    scaled = filtered / safe_temp
+    if key.ndim == 2:
+        sampled = jax.vmap(jax.random.categorical)(key, scaled)
+    else:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
 
     use_greedy = jnp.any(temp <= 0.0, axis=-1) if temp.ndim else temp <= 0.0
     return jnp.where(use_greedy, greedy, sampled).astype(jnp.int32)

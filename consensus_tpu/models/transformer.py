@@ -250,20 +250,24 @@ def forward(
 
         if c.use_flash_attention and cache is None:
             # Pallas blockwise kernel: no (B, H, S, S) logits in HBM.  The
-            # kernel's masking model is right-padded prefix-valid rows (the
-            # scoring layout), so validity reduces to per-row lengths.
+            # kernel's masking model is one contiguous valid span per row,
+            # described by (start, length) scalars — start=0 covers the
+            # right-padded scoring layout, start=argmax(valid) the
+            # left-padded next-token/embed layout (rows with no valid token
+            # get length 0 and an empty mask either way).
             # ``is_local`` is a traced scan input, so window selection is a
             # lax.cond between two statically-windowed kernel calls.
             from consensus_tpu.ops.flash_attention import flash_attention
 
             interp = jax.default_backend() == "cpu"
             lengths = jnp.sum(valid.astype(jnp.int32), axis=1)
+            starts = jnp.argmax(valid, axis=1).astype(jnp.int32)
 
             def call_flash(window):
                 def fn(operands):
                     qq, kk, vv = operands
                     return flash_attention(
-                        qq, kk, vv, lengths,
+                        qq, kk, vv, lengths, starts,
                         scale=c.q_scale, softcap=c.attn_softcap,
                         window=window, causal=True, interpret=interp,
                     )

@@ -8,12 +8,15 @@ VMEM with the streaming-softmax (flash) recurrence: per (batch·head,
 Q-block) it iterates K-blocks keeping running (max, sum, accumulator)
 scratch, so HBM traffic is O(S·hd) instead of O(S²).
 
-Masking model: rows are right-padded prefix-valid sequences — exactly the
-scoring path's layout — so per-row a single LENGTH scalar (SMEM) defines
-validity, and positions are the block-local iota.  This keeps every VMEM
-operand 3-D with Mosaic-legal tiles ((block, hd) with block a multiple of 8
-and hd a lane multiple); the wrapper pads the sequence up to a block
-multiple and slices the padding back off.
+Masking model: rows hold ONE contiguous valid span ``[start, start+length)``
+— right-padded scoring batches have ``start == 0``; left-padded generation/
+next-token/embed batches have ``start == S - length``.  Two per-row scalars
+(SMEM) define validity, and positions are the global iota; because the span
+is contiguous, iota-based causal/window tests equal the RoPE-position tests
+(position == iota - start inside the span).  This keeps every VMEM operand
+3-D with Mosaic-legal tiles ((block, hd) with block a multiple of 8 and hd
+a lane multiple); the wrapper pads the sequence up to a block multiple and
+slices the padding back off.
 
 Supports causal masking, Gemma-2's sliding-window local layers
 (``window``), and the attention logit softcap.  Numerics are pinned against
@@ -38,7 +41,8 @@ DEFAULT_BLOCK_K = 128
 
 
 def _kernel(
-    len_ref,  # (BH,) int32 in SMEM — all rows' valid-prefix lengths
+    len_ref,  # (BH,) int32 in SMEM — all rows' valid-span lengths
+    start_ref,  # (BH,) int32 in SMEM — all rows' valid-span start offsets
     q_ref,  # (1, BQ, hd)
     k_ref,  # (1, BK, hd)
     v_ref,  # (1, BK, hd)
@@ -66,6 +70,7 @@ def _kernel(
         acc_scratch[...] = jnp.zeros_like(acc_scratch)
 
     length = len_ref[bh]
+    start = start_ref[bh]
     q = q_ref[0].astype(jnp.float32)  # (BQ, hd)
     k = k_ref[0].astype(jnp.float32)  # (BK, hd)
     v = v_ref[0].astype(jnp.float32)
@@ -76,10 +81,13 @@ def _kernel(
     if softcap is not None:
         logits = softcap * jnp.tanh(logits / softcap)
 
-    # Positions are the global iota of this right-padded layout.
+    # Positions are the global iota; validity is the contiguous span
+    # [start, start+length) — start==0 for right-padded scoring rows,
+    # start==S-length for left-padded generation/next-token/embed rows.
     qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
     kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
-    mask = (qpos < length) & (kpos < length)
+    end = start + length
+    mask = (qpos >= start) & (qpos < end) & (kpos >= start) & (kpos < end)
     if causal:
         mask = mask & (kpos <= qpos)
     if window is not None:
@@ -118,7 +126,8 @@ def flash_attention(
     q: jax.Array,  # (B, S, H, hd)
     k: jax.Array,  # (B, S, H, hd) — post-GQA-repeat, same head count as q
     v: jax.Array,
-    lengths: jax.Array,  # (B,) int32 — valid-prefix length per row
+    lengths: jax.Array,  # (B,) int32 — valid-span length per row
+    starts: Optional[jax.Array] = None,  # (B,) int32 — span start (default 0)
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
     window: Optional[int] = None,
@@ -127,9 +136,11 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
-    """Blockwise-streaming attention over right-padded prefix-valid rows.
+    """Blockwise-streaming attention over rows with one contiguous valid span.
 
-    Returns (B, S, H, hd) in q's dtype; rows beyond ``lengths`` are zero.
+    ``starts=None`` (all zeros) is the right-padded scoring layout;
+    ``starts = S - lengths`` is the left-padded generation layout.
+    Returns (B, S, H, hd) in q's dtype; positions outside the span are zero.
     """
     batch, seq, heads, head_dim = q.shape
     if scale is None:
@@ -149,6 +160,9 @@ def flash_attention(
 
     qf, kf, vf = fold(q), fold(k), fold(v)
     lens = jnp.repeat(lengths.astype(jnp.int32), heads, axis=0)  # (BH,)
+    if starts is None:
+        starts = jnp.zeros_like(lengths)
+    offs = jnp.repeat(starts.astype(jnp.int32), heads, axis=0)  # (BH,)
 
     q_steps = padded // block_q
     k_steps = padded // block_k
@@ -172,6 +186,9 @@ def flash_attention(
             pl.BlockSpec(
                 (batch * heads,), lambda b, qi, ki: (0,), memory_space=pltpu.SMEM
             ),
+            pl.BlockSpec(
+                (batch * heads,), lambda b, qi, ki: (0,), memory_space=pltpu.SMEM
+            ),
             pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_k, head_dim), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, head_dim), lambda b, qi, ki: (b, ki, 0)),
@@ -184,7 +201,7 @@ def flash_attention(
             pltpu.VMEM((block_q, head_dim), jnp.float32),
         ],
         interpret=interpret,
-    )(lens, qf, kf, vf)
+    )(lens, offs, qf, kf, vf)
 
     out = out.reshape(batch, heads, padded, head_dim).transpose(0, 2, 1, 3)
     return out[:, :seq]

@@ -52,6 +52,36 @@ class TestGenerate:
             )[0]
             assert stop_char not in stopped.text
 
+    def test_same_request_independent_of_batch(self, backend):
+        """Regression (VERDICT r1 #7): a request's output must not depend on
+        which other requests share its device batch — per-row PRNG keys."""
+        probe = GenerationRequest(
+            user_prompt="Independent request", max_tokens=6, seed=7,
+            temperature=0.9,
+        )
+        alone = backend.generate([probe])[0]
+        other = GenerationRequest(
+            user_prompt="A different companion", max_tokens=6, seed=11,
+            temperature=0.9,
+        )
+        batched = backend.generate([other, probe])[1]
+        assert alone.text == batched.text
+        assert alone.token_ids == batched.token_ids
+
+    def test_unseeded_duplicate_requests_stay_diverse(self, backend):
+        """Unseeded identical prompts in one batch (best_of_n drafts,
+        habermas candidates) must each get a distinct sampling stream."""
+        requests = [
+            GenerationRequest(
+                user_prompt="Draft a statement", max_tokens=8, seed=None,
+                temperature=1.0,
+            )
+            for _ in range(3)
+        ]
+        results = backend.generate(requests)
+        token_sets = {r.token_ids for r in results}
+        assert len(token_sets) > 1
+
     def test_greedy_at_zero_temperature(self, backend):
         requests = [
             GenerationRequest(user_prompt="Greedy", max_tokens=5, temperature=0.0,
@@ -115,6 +145,28 @@ class TestNextToken:
         assert any(
             x.token_id != y.token_id for x, y in zip(a, c)
         ) or len(a) != len(c)
+
+    def test_sample_independent_of_batch(self, backend):
+        """Device-side Gumbel-top-k uses per-row keys: candidates for a
+        request match whether it runs alone or batched."""
+        probe = NextTokenRequest(user_prompt="Probe", k=4, mode="sample", seed=5)
+        alone = backend.next_token_logprobs([probe])[0]
+        other = NextTokenRequest(
+            user_prompt="Companion prompt", k=4, mode="sample", seed=9
+        )
+        batched = backend.next_token_logprobs([other, probe])[1]
+        assert [c.token_id for c in alone] == [c.token_id for c in batched]
+
+    def test_larger_k_is_prefix_superset(self, backend):
+        """Gumbel-top-k without replacement: asking for more candidates keeps
+        the smaller request's set (same row key, same scores)."""
+        small = backend.next_token_logprobs(
+            [NextTokenRequest(user_prompt="Prefix", k=3, mode="sample", seed=4)]
+        )[0]
+        large = backend.next_token_logprobs(
+            [NextTokenRequest(user_prompt="Prefix", k=6, mode="sample", seed=4)]
+        )[0]
+        assert {c.token_id for c in small} <= {c.token_id for c in large}
 
     def test_bias_suppresses_tokens(self, backend):
         top = backend.next_token_logprobs(
