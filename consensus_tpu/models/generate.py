@@ -50,12 +50,17 @@ def generate_tokens(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_ids: Optional[jax.Array] = None,  # (E,) int32; None/empty = no EOS stop
-    logit_bias: Optional[jax.Array] = None,  # (V,) additive
+    logit_bias: Optional[jax.Array] = None,  # (V,) or (B, V) additive
+    bias_table: Optional[jax.Array] = None,  # (U, V) unique bias vectors
+    bias_index: Optional[jax.Array] = None,  # (B,) int32 row -> table index
     pad_id: int = 0,
 ) -> GenerateOutput:
     batch, s_ctx = prompt_tokens.shape
     if eos_ids is None:
         eos_ids = jnp.zeros((0,), jnp.int32)
+    if bias_table is not None:
+        # Dedup table shipped from host; per-row bias rows gather ON device.
+        logit_bias = bias_table[bias_index]
 
     cache = make_cache(config, batch, s_ctx + max_new_tokens, params["embed"].dtype)
     positions = left_pad_positions(prompt_valid)

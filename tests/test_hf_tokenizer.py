@@ -1,0 +1,126 @@
+"""HFTokenizer wrapper tests against a locally built fast tokenizer.
+
+The zero-egress environment has no pretrained tokenizer on disk, so the
+test builds a tiny byte-level BPE tokenizer with Gemma-style special tokens
+using the ``tokenizers`` library, saves it in HF format, and exercises the
+production ``HFTokenizer`` code path (AutoTokenizer local load, EOS-id
+discovery, chat templating, substring token matching — the behaviours the
+reference grounds in token strings, SURVEY §7.3).
+"""
+
+import json
+
+import pytest
+from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+from consensus_tpu.models.tokenizer import HFTokenizer, get_tokenizer
+
+CORPUS = [
+    "Should the city center become car-free on weekends?",
+    "Pedestrian zones boost local shops and make streets safer.",
+    "Deliveries and disabled access need vehicles.",
+    "We will pilot car-free weekends one Sunday a month.",
+    "The quick brown fox jumps over the lazy dog.",
+]
+
+SPECIALS = ["<pad>", "<bos>", "<eos>", "<start_of_turn>", "<end_of_turn>"]
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("hf_tok")
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(vocab_size=384, special_tokens=SPECIALS)
+    tok.train_from_iterator(CORPUS, trainer)
+    tok.save(str(path / "tokenizer.json"))
+    (path / "tokenizer_config.json").write_text(
+        json.dumps(
+            {
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "bos_token": "<bos>",
+                "eos_token": "<eos>",
+                "pad_token": "<pad>",
+            }
+        )
+    )
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def tokenizer(hf_dir):
+    return HFTokenizer(hf_dir, family="gemma")
+
+
+def test_get_tokenizer_dispatches_to_hf(hf_dir):
+    tok = get_tokenizer(hf_dir, family="gemma")
+    assert isinstance(tok, HFTokenizer)
+
+
+def test_encode_decode_roundtrip(tokenizer):
+    text = "car-free weekends boost local shops"
+    ids = tokenizer.encode(text)
+    assert ids and all(isinstance(i, int) for i in ids)
+    assert tokenizer.decode(ids) == text
+
+
+def test_bos_prefixed_when_requested(tokenizer):
+    plain = tokenizer.encode("hello")
+    with_bos = tokenizer.encode("hello", add_bos=True)
+    assert with_bos == [tokenizer.bos_id] + plain
+
+
+def test_eos_ids_include_end_of_turn(tokenizer):
+    """Gemma family: both <eos> and <end_of_turn> must stop generation
+    (reference EOS string set, beam_search.py:26-35)."""
+    eot = tokenizer._tok.convert_tokens_to_ids("<end_of_turn>")
+    assert tokenizer._tok.eos_token_id in tokenizer.eos_ids
+    assert eot in tokenizer.eos_ids
+
+
+def test_decode_skips_pad_and_specials(tokenizer):
+    ids = tokenizer.encode("pilot", add_bos=True)
+    padded = [tokenizer.pad_id] * 3 + ids
+    assert tokenizer.decode(padded) == "pilot"
+
+
+def test_gemma_chat_template(tokenizer):
+    prompt = tokenizer.chat_prompt("What do you think?", system="Be brief.")
+    assert prompt.startswith("<start_of_turn>user\n")
+    assert "Be brief.\n\nWhat do you think?" in prompt
+    assert prompt.endswith("<start_of_turn>model\n")
+    # Gemma has no system role: system folds into the user turn.
+    assert "system" not in prompt
+
+
+def test_llama_chat_template(hf_dir):
+    tok = HFTokenizer(hf_dir, family="llama")
+    prompt = tok.chat_prompt("Hi", system="Sys")
+    assert "<|start_header_id|>system<|end_header_id|>" in prompt
+    assert prompt.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+
+
+def test_token_ids_containing_substring(tokenizer):
+    ids = tokenizer.token_ids_containing("week")
+    assert ids
+    for token_id in ids:
+        assert "week" in tokenizer.token_str(token_id)
+
+
+def test_tpu_backend_accepts_hf_tokenizer(hf_dir):
+    """End-to-end: TPUBackend on the HF tokenizer path generates and scores."""
+    from consensus_tpu.backends.base import GenerationRequest, ScoreRequest
+    from consensus_tpu.backends.tpu import TPUBackend
+
+    backend = TPUBackend(
+        model="tiny-gemma2", tokenizer=hf_dir, max_context=128, base_seed=0
+    )
+    result = backend.generate(
+        [GenerationRequest(user_prompt="weekends", max_tokens=4, seed=1)]
+    )[0]
+    assert result.finish_reason in ("stop", "length")
+    score = backend.score(
+        [ScoreRequest(context="car-free", continuation=" weekends")]
+    )[0]
+    assert score.ok and all(lp <= 0.0 for lp in score.logprobs)

@@ -1,0 +1,170 @@
+"""Checkpoint-loader and HF-tokenizer path tests (VERDICT r1 #2).
+
+No real checkpoint exists in this zero-egress environment, so the loader is
+proven with synthetic HF-format safetensors fixtures: a random runtime
+pytree is exported under HuggingFace parameter names (the exact inverse of
+the loader's mapping — transposed projections, per-layer norms) and read
+back with ``load_params``; tree equality then validates every transpose,
+layer-stack placement, and norm-routing rule for both families:
+
+* Gemma-2 layout — tied LM head, all four per-layer norms
+  (input / post_attention / pre_feedforward / post_feedforward);
+* Llama-3 layout — untied ``lm_head.weight``, pre-norms only
+  (input / post_attention -> ffn_norm).
+
+Reference model usage these layouts serve:
+configs/appendix/gemma/scenario_1/beam_search.yaml:4-12 (Gemma-2-9b-it) and
+configs/main_body (Llama-3.1 evaluation models).
+"""
+
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+from consensus_tpu.models.config import get_model_config
+from consensus_tpu.models.loader import infer_config_name, load_params
+from consensus_tpu.models.transformer import init_params, token_logprobs
+
+
+def _export_hf(params, config, out_dir: pathlib.Path, shards: int = 1):
+    """Write a runtime pytree as HF-named safetensors (loader's inverse)."""
+    c = config
+    tensors = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    if not c.tie_lm_head:
+        tensors["lm_head.weight"] = np.asarray(params["lm_head"], np.float32)
+    layers = params["layers"]
+    for i in range(c.n_layers):
+        prefix = f"model.layers.{i}."
+        for ours, hf, transpose in (
+            ("wq", "self_attn.q_proj.weight", True),
+            ("wk", "self_attn.k_proj.weight", True),
+            ("wv", "self_attn.v_proj.weight", True),
+            ("wo", "self_attn.o_proj.weight", True),
+            ("w_gate", "mlp.gate_proj.weight", True),
+            ("w_up", "mlp.up_proj.weight", True),
+            ("w_down", "mlp.down_proj.weight", True),
+        ):
+            mat = np.asarray(layers[ours][i], np.float32)
+            # safetensors dumps the raw buffer: transposed views MUST be
+            # materialized contiguous or the file is silently garbage.
+            tensors[prefix + hf] = np.ascontiguousarray(mat.T) if transpose else mat
+        tensors[prefix + "input_layernorm.weight"] = np.asarray(
+            layers["attn_norm"][i], np.float32
+        )
+        if c.use_post_norms:
+            tensors[prefix + "post_attention_layernorm.weight"] = np.asarray(
+                layers["post_attn_norm"][i], np.float32
+            )
+            tensors[prefix + "pre_feedforward_layernorm.weight"] = np.asarray(
+                layers["ffn_norm"][i], np.float32
+            )
+            tensors[prefix + "post_feedforward_layernorm.weight"] = np.asarray(
+                layers["post_ffn_norm"][i], np.float32
+            )
+        else:
+            tensors[prefix + "post_attention_layernorm.weight"] = np.asarray(
+                layers["ffn_norm"][i], np.float32
+            )
+    out_dir.mkdir(parents=True, exist_ok=True)
+    names = sorted(tensors)
+    chunk = -(-len(names) // shards)
+    for s in range(shards):
+        piece = {n: tensors[n] for n in names[s * chunk : (s + 1) * chunk]}
+        suffix = f"-{s:05d}-of-{shards:05d}" if shards > 1 else ""
+        save_file(piece, str(out_dir / f"model{suffix}.safetensors"))
+
+
+def _assert_tree_equal(a, b):
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(a)
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(b)[0])
+    assert len(flat_a) == len(flat_b)
+    for path, leaf in flat_a:
+        np.testing.assert_allclose(
+            np.asarray(leaf, np.float32),
+            np.asarray(dict(flat_b)[path], np.float32),
+            atol=1e-6,
+            err_msg=str(path),
+        )
+
+
+@pytest.mark.parametrize("model", ["tiny-gemma2", "tiny-llama3"])
+def test_roundtrip_hf_layout(model, tmp_path):
+    config = get_model_config(model)
+    params = init_params(config, jax.random.PRNGKey(0))
+    _export_hf(params, config, tmp_path / model)
+    loaded = load_params(str(tmp_path / model), config, jnp.float32)
+    _assert_tree_equal(params, loaded)
+
+
+def test_roundtrip_sharded_checkpoint(tmp_path):
+    """Multi-shard safetensors (the production layout) merge correctly."""
+    config = get_model_config("tiny-gemma2")
+    params = init_params(config, jax.random.PRNGKey(1))
+    _export_hf(params, config, tmp_path / "sharded", shards=3)
+    loaded = load_params(str(tmp_path / "sharded"), config, jnp.float32)
+    _assert_tree_equal(params, loaded)
+
+
+def test_loaded_params_run_forward(tmp_path):
+    """Loaded checkpoints produce the same logprobs as the source pytree."""
+    config = get_model_config("tiny-llama3")
+    params = init_params(config, jax.random.PRNGKey(2))
+    _export_hf(params, config, tmp_path / "fwd")
+    loaded = load_params(str(tmp_path / "fwd"), config, jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 512, jnp.int32)
+    valid = jnp.ones((2, 16), bool)
+    np.testing.assert_allclose(
+        np.asarray(token_logprobs(params, config, tokens, valid)),
+        np.asarray(token_logprobs(loaded, config, tokens, valid)),
+        atol=1e-5,
+    )
+
+
+def test_missing_embed_raises(tmp_path):
+    config = get_model_config("tiny-gemma2")
+    save_file(
+        {"model.norm.weight": np.zeros((config.d_model,), np.float32)},
+        str(tmp_path / "model.safetensors"),
+    )
+    with pytest.raises(ValueError, match="embed_tokens"):
+        load_params(str(tmp_path), config)
+
+
+def test_untied_head_required(tmp_path):
+    config = get_model_config("tiny-llama3")
+    params = init_params(config, jax.random.PRNGKey(4))
+    _export_hf(params, config, tmp_path)
+    (tmp_path / "model.safetensors").unlink()
+    tensors = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    with pytest.raises(ValueError, match="lm_head"):
+        load_params(str(tmp_path), config)
+
+
+@pytest.mark.parametrize(
+    "hf_config,expected",
+    [
+        ({"model_type": "gemma2", "hidden_size": 2304}, "gemma2-2b"),
+        ({"model_type": "gemma2", "hidden_size": 3584}, "gemma2-9b"),
+        ({"model_type": "llama", "hidden_size": 4096}, "llama3-8b"),
+        ({"model_type": "mistral", "hidden_size": 4096}, None),
+    ],
+)
+def test_infer_config_name(hf_config, expected, tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps(hf_config))
+    assert infer_config_name(str(tmp_path)) == expected
+
+
+def test_infer_config_name_no_file(tmp_path):
+    assert infer_config_name(str(tmp_path)) is None
