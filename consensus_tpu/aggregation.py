@@ -103,6 +103,48 @@ def _metric_columns(frame: pd.DataFrame) -> List[str]:
     ]
 
 
+def format_aggregated_columns(frame: pd.DataFrame) -> pd.DataFrame:
+    """Reorder aggregate columns into the reference's presentation order
+    (``create_formatted_output``, improved_aggregation.py:578-700):
+    identity cols, sorted ``param_*`` cols, then metric families
+    perplexity → cosine → rank, each grouped by model prefix with
+    egalitarian → utilitarian → per-agent subcategories and mean before
+    std; unmatched columns keep their original order at the end.
+
+    Re-designed as one deterministic sort key instead of the reference's
+    nested category loops.
+    """
+    identity = [c for c in ("method", "method_with_params") if c in frame.columns]
+    params = sorted(c for c in frame.columns if c.startswith("param_"))
+    rest = [c for c in frame.columns if c not in identity and c not in params]
+
+    families = ("perplexity", "cosine", "rank")
+    subcategories = ("egalitarian", "utilitarian", "Agent")
+
+    def key(column: str):
+        family = next((i for i, f in enumerate(families) if f in column), None)
+        if family is None:
+            return (len(families), 0, "", "", rest.index(column))
+        # Model prefix = text before the first metric stem (sanitized model
+        # names may contain underscores; unprefixed judge metrics get "").
+        stems = (
+            "egalitarian_", "utilitarian_", "log_nash_", "cosine_",
+            "perplexity_", "avg_logprob_", "min_rank", "max_rank",
+            "avg_rank", "rank_",
+        )
+        cut = min((column.find(s) for s in stems if s in column), default=0)
+        model = column[:cut]
+        sub = next(
+            (i for i, s in enumerate(subcategories) if s in column),
+            len(subcategories),
+        )
+        base = re.sub(r"_(mean|std)$", "", column)
+        return (family, 0, model, (sub, base, column.endswith("_std")), 0)
+
+    ordered = identity + params + sorted(rest, key=key)
+    return frame[ordered]
+
+
 def aggregate_run_dir(run_dir: str) -> Optional[pd.DataFrame]:
     """Aggregate one run directory; writes
     ``evaluation/improved_aggregate/aggregated_metrics{,_raw}.csv`` and
@@ -158,7 +200,7 @@ def aggregate_run_dir(run_dir: str) -> Optional[pd.DataFrame]:
             {k: v for k, v in stats.items() if k not in ("method",)}
         )
         rows.append(row)
-    aggregated = pd.DataFrame(rows)
+    aggregated = format_aggregated_columns(pd.DataFrame(rows))
 
     out_dir = run_path / "evaluation" / "improved_aggregate"
     out_dir.mkdir(parents=True, exist_ok=True)

@@ -33,6 +33,7 @@ def get_backend(spec: Optional[Any] = None, **kwargs) -> Backend:
       * ``None`` / ``"fake"``  -> :class:`FakeBackend`
       * ``"tpu"``              -> :class:`~consensus_tpu.backends.tpu.TPUBackend`
       * ``"api"``              -> :class:`~consensus_tpu.backends.api.APIBackend`
+      * ``"openai"``           -> :class:`~consensus_tpu.backends.api.OpenAIBackend` (LLM judge)
       * ``{"name": ..., ...}`` -> as above with constructor kwargs
       * an object already implementing :class:`Backend` -> returned unchanged
     """
@@ -66,6 +67,10 @@ def get_backend(spec: Optional[Any] = None, **kwargs) -> Backend:
         from consensus_tpu.backends.api import APIBackend
 
         backend = APIBackend(**kwargs)
+    elif name == "openai":
+        from consensus_tpu.backends.api import OpenAIBackend
+
+        backend = OpenAIBackend(**kwargs)
     else:
         raise ValueError(f"Unknown backend: {name!r}")
 

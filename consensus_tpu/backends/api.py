@@ -217,3 +217,81 @@ class APIBackend:
         stacked = np.stack(vectors)
         norms = np.linalg.norm(stacked, axis=1, keepdims=True)
         return stacked / np.maximum(norms, 1e-12)
+
+
+#: Judge-model aliases the reference hardcodes: asking for "o3" actually
+#: calls gpt-4.1 with temperature 0 and seed 42 (src/evaluation.py:447-462).
+JUDGE_MODEL_ALIASES = {"o3": "gpt-4.1"}
+JUDGE_SEED = 42
+
+
+class OpenAIBackend:
+    """OpenAI chat backend — the reference's LLM-judge path (L1 OpenAI leg,
+    src/evaluation.py:23,456,714,744).
+
+    Only ``generate`` is remote (judging is pure text-in/text-out); scoring,
+    next-token and embeddings are not served by the judge API, so they
+    return the same error sentinels the reference degrades to.  JSON mode is
+    requested when the prompt asks for JSON (the judge prompts do).
+    """
+
+    name = "openai"
+
+    def __init__(
+        self,
+        model: str = "o3",
+        rate_limit: float = 5.0,
+        json_mode: bool = True,
+    ):
+        self.requested_model = model
+        self.model = JUDGE_MODEL_ALIASES.get(model, model)
+        self.json_mode = json_mode
+        self.rate_limiter = RateLimiter(rate_limit)
+        self._client = None
+        try:  # pragma: no cover - zero-egress environment
+            from openai import OpenAI  # type: ignore
+
+            self._client = OpenAI()
+        except Exception as exc:
+            logger.warning("OpenAIBackend: client unavailable (%s)", exc)
+
+    def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
+        return [self._generate_one(r) for r in requests]
+
+    def _generate_one(self, request: GenerationRequest) -> GenerationResult:
+        if self._client is None:
+            return GenerationResult(
+                text="[ERROR: OpenAI client not initialized]", finish_reason="error"
+            )
+        self.rate_limiter.wait_for_token()
+        try:  # pragma: no cover
+            messages = []
+            if request.system_prompt:
+                messages.append({"role": "system", "content": request.system_prompt})
+            messages.append({"role": "user", "content": request.user_prompt})
+            kwargs = {}
+            if self.json_mode and "json" in request.user_prompt.lower():
+                kwargs["response_format"] = {"type": "json_object"}
+            response = self._client.chat.completions.create(
+                model=self.model,
+                messages=messages,
+                temperature=0.0,
+                seed=JUDGE_SEED,
+                **kwargs,
+            )
+            return GenerationResult(
+                text=response.choices[0].message.content or "", finish_reason="stop"
+            )
+        except Exception as exc:
+            return GenerationResult(text=f"[ERROR: {exc}]", finish_reason="error")
+
+    def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+        return [ScoreResult(tokens=(), logprobs=()) for _ in requests]
+
+    def next_token_logprobs(
+        self, requests: Sequence[NextTokenRequest]
+    ) -> List[List[TokenCandidate]]:
+        return [[] for _ in requests]
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        return np.zeros((len(texts), 1024), np.float32)

@@ -70,12 +70,20 @@ class ScoreRequest:
     zero-width-space marker hack) — on-device we simply tokenize context and
     continuation and gather the continuation logprobs directly
     (SURVEY §7.3 "logprob-extraction semantics").
+
+    ``role`` selects where the continuation sits in the chat template:
+    ``"assistant"`` (default) scores it as a model reply after the user
+    turn; ``"user"`` scores it INSIDE the user turn with ``context`` in the
+    system slot — the reference's evaluation semantics (its scorer echoes
+    the statement as the *user prompt* with the eval template as system,
+    src/evaluation.py:182-193).  Only meaningful with ``chat=True``.
     """
 
     context: str
     continuation: str
     system_prompt: Optional[str] = None
     chat: bool = True
+    role: str = "assistant"  # "assistant" | "user"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,164 @@
+"""Cross-run device batching: the TPU-native thread pool replacement.
+
+The reference hides HTTP latency by fanning (method × param × seed) combos
+across a ``ThreadPoolExecutor`` (src/experiment.py:283-322).  On-device the
+model is the bottleneck, so the win is different: independent runs that are
+at the same phase should share ONE padded device batch instead of issuing
+small batches back-to-back (SURVEY §2.16 "batch/shard (seeds × scenarios ×
+methods) across chips").
+
+:class:`BatchingBackend` wraps an inner backend.  Worker threads (one per
+concurrent run) register a :meth:`session`; each protocol call enqueues its
+requests and blocks.  A batch flushes when EVERY active session has a call
+pending (all threads blocked → nothing more can arrive) or when a waiter
+times out (``flush_ms`` — a session doing host-side work shouldn't stall
+the others).  The flushing thread concatenates same-kind requests, executes
+them on the inner backend as one batch, and distributes the slices.
+
+Correctness: per-request PRNG keys (backends/tpu.py) make every result
+independent of batch composition, so merged batches are bit-identical to
+solo execution — concurrency changes throughput, never results.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Sequence
+
+import numpy as np
+
+from consensus_tpu.backends.base import (
+    Backend,
+    GenerationRequest,
+    GenerationResult,
+    NextTokenRequest,
+    ScoreRequest,
+    ScoreResult,
+    TokenCandidate,
+)
+
+
+class _Pending:
+    __slots__ = ("requests", "result", "error", "done")
+
+    def __init__(self, requests):
+        self.requests = requests
+        self.result = None
+        self.error = None
+        self.done = False
+
+
+class BatchingBackend:
+    """Merge concurrent sessions' backend calls into shared device batches."""
+
+    name = "batching"
+
+    def __init__(
+        self, inner: Backend, flush_ms: float = 10.0, expected_sessions: int = 1
+    ):
+        self.inner = inner
+        self.flush_s = flush_ms / 1000.0
+        #: Until this many sessions have STARTED, the all-blocked heuristic
+        #: is suppressed — otherwise the first worker to enqueue during pool
+        #: ramp-up sees active==1 and flushes a batch of one.
+        self.expected_sessions = max(1, expected_sessions)
+        self._cond = threading.Condition()
+        self._active = 0
+        self._started = 0
+        self._queues: Dict[str, List[_Pending]] = {
+            "generate": [], "score": [], "next_token": [], "embed": [],
+        }
+        #: Device batches actually issued per kind — the measurable win:
+        #: N concurrent runs << N× the solo batch count.
+        self.batch_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+
+    @contextlib.contextmanager
+    def session(self):
+        """Register the calling thread as an active run for flush accounting."""
+        with self._cond:
+            self._active += 1
+            self._started += 1
+        try:
+            yield self
+        finally:
+            with self._cond:
+                self._active -= 1
+                # A departing session may complete the "all blocked" condition.
+                self._cond.notify_all()
+
+    # -- protocol ----------------------------------------------------------
+
+    def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
+        return self._call("generate", list(requests), self.inner.generate)
+
+    def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+        return self._call("score", list(requests), self.inner.score)
+
+    def next_token_logprobs(
+        self, requests: Sequence[NextTokenRequest]
+    ) -> List[List[TokenCandidate]]:
+        return self._call(
+            "next_token", list(requests), self.inner.next_token_logprobs
+        )
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        out = self._call("embed", list(texts), self.inner.embed)
+        return np.asarray(out)
+
+    # -- core --------------------------------------------------------------
+
+    def _call(self, kind: str, requests: List[Any], fn: Callable) -> Any:
+        if not requests:
+            return fn(requests)
+        entry = _Pending(requests)
+        with self._cond:
+            self._queues[kind].append(entry)
+            self._cond.notify_all()
+            while not entry.done:
+                pending = sum(len(q) for q in self._queues.values())
+                ramped = self._started >= self.expected_sessions
+                if ramped and pending >= max(self._active, 1):
+                    # Every active session is blocked on a call: flush now.
+                    self._flush_locked()
+                elif not self._cond.wait(timeout=self.flush_s):
+                    # Timeout: some session is busy host-side; don't stall.
+                    self._flush_locked()
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    def _flush_locked(self) -> None:
+        """Execute all queued batches.  Called with the lock held; the inner
+        call runs under the lock — other sessions are blocked waiting for
+        results anyway, and single-threading device access is required."""
+        for kind, fn in (
+            ("generate", self.inner.generate),
+            ("score", self.inner.score),
+            ("next_token", self.inner.next_token_logprobs),
+            ("embed", self.inner.embed),
+        ):
+            queue = self._queues[kind]
+            if not queue:
+                continue
+            self._queues[kind] = []
+            merged: List[Any] = []
+            for entry in queue:
+                merged.extend(entry.requests)
+            self.batch_counts[kind] += 1
+            try:
+                results = fn(merged)
+                cursor = 0
+                for entry in queue:
+                    n = len(entry.requests)
+                    if kind == "embed":
+                        entry.result = np.asarray(results[cursor : cursor + n])
+                    else:
+                        entry.result = list(results[cursor : cursor + n])
+                    cursor += n
+                    entry.done = True
+            except Exception as exc:  # fail every waiter in this batch
+                for entry in queue:
+                    entry.error = exc
+                    entry.done = True
+        self._cond.notify_all()

@@ -39,6 +39,7 @@ logger = logging.getLogger(__name__)
 def run_pipeline(
     config_path: str,
     skip_comparative_ranking: bool = False,
+    skip_llm_judge: bool = False,
     llm_judge_model: str = "",
     evaluation_models: Optional[List[str]] = None,
 ) -> str:
@@ -56,19 +57,21 @@ def run_pipeline(
     issue = scenario.get("issue", "")
     agent_opinions = dict(scenario.get("agent_opinions", {}))
 
-    # ---- Phase 2a: per-seed comparative ranking -----------------------
-    if not skip_comparative_ranking:
-        logger.info("=== Phase 2a: LLM-judge comparative ranking ===")
-        # Judge backend construction is deferred to here: with the phase
-        # skipped, a judge_backend: tpu config must not pay a model load.
+    # Judge backend construction stays LAZY: with both judge phases
+    # skipped, a judge_backend: tpu/openai config must not pay a model load.
+    _judge_cache: List = []
+
+    def judge_backend_lazy():
+        if _judge_cache:
+            return _judge_cache[0]
         judge_options = dict(config.get("judge_backend_options") or {})
         if llm_judge_model:
-            # Route the requested judge model to the backend (the reference
-            # aliases judge "o3" -> gpt-4.1 inside its OpenAI path,
-            # src/evaluation.py:447-462; ours is the backend's concern).
+            # Route the requested judge model to the backend; the "o3" ->
+            # gpt-4.1 aliasing lives in OpenAIBackend (reference
+            # src/evaluation.py:447-462).
             judge_options.setdefault("model", llm_judge_model)
         if config.get("judge_backend"):
-            judge_backend = get_backend(config["judge_backend"], **judge_options)
+            judge = get_backend(config["judge_backend"], **judge_options)
         else:
             if llm_judge_model:
                 logger.warning(
@@ -76,9 +79,17 @@ def run_pipeline(
                     "key, so the generation backend judges with its own model",
                     llm_judge_model,
                 )
-            judge_backend = backend
+            judge = backend
+        _judge_cache.append(judge)
+        return judge
+
+    # ---- Phase 2a: per-seed comparative ranking -----------------------
+    if not skip_comparative_ranking:
+        logger.info("=== Phase 2a: LLM-judge comparative ranking ===")
         evaluator = StatementEvaluator(
-            backend, judge_backend=judge_backend, llm_judge_model=llm_judge_model
+            backend,
+            judge_backend=judge_backend_lazy(),
+            llm_judge_model=llm_judge_model,
         )
         for seed_index, seed in enumerate(sorted(results["seed"].unique())):
             subset = results[
@@ -128,14 +139,27 @@ def run_pipeline(
             "models to distinct backends",
             len(models),
         )
+    # Per-agent judge scores in standard evaluation run only when a judge
+    # backend is configured and --skip-llm-judge wasn't passed (the flag the
+    # reference accepts at run_experiment_with_eval.py:465-509).
+    include_llm_judge = not skip_llm_judge and bool(config.get("judge_backend"))
     for model in models:
         model_backend = (
             get_backend(dict(eval_backends[model]))
             if model in eval_backends
             else backend
         )
-        evaluator = StatementEvaluator(model_backend, evaluation_model=model)
-        evaluator.evaluate_results_file(str(run_dir / "results.csv"), config=config)
+        evaluator = StatementEvaluator(
+            model_backend,
+            evaluation_model=model,
+            judge_backend=judge_backend_lazy() if include_llm_judge else None,
+            llm_judge_model=llm_judge_model,
+        )
+        evaluator.evaluate_results_file(
+            str(run_dir / "results.csv"),
+            config=config,
+            include_llm_judge=include_llm_judge,
+        )
         logger.info("Evaluated with %s", sanitize_model_name(model))
 
     # ---- Phase 3: aggregation (improved, basic fallback) --------------
@@ -156,6 +180,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(description="Run experiment + evaluation")
     parser.add_argument("-c", "--config", required=True)
     parser.add_argument("--skip-comparative-ranking", action="store_true")
+    parser.add_argument(
+        "--skip-llm-judge", action="store_true",
+        help="skip per-agent LLM-judge scores in standard evaluation",
+    )
     parser.add_argument("--llm-judge-model", default="")
     parser.add_argument("--evaluation-models", nargs="*", default=None)
     parser.add_argument("--quiet", action="store_true")
@@ -165,6 +193,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     run_dir = run_pipeline(
         args.config,
         skip_comparative_ranking=args.skip_comparative_ranking,
+        skip_llm_judge=args.skip_llm_judge,
         llm_judge_model=args.llm_judge_model,
         evaluation_models=args.evaluation_models,
     )

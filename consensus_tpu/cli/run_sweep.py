@@ -8,8 +8,9 @@ in-process so the TPU backend's compiled programs are REUSED across the
 sweep — recompiling a 2B-model decode loop per subprocess would dwarf the
 actual compute.
 
-Usage: ``python -m consensus_tpu.cli.run_sweep --configs-root configs/sweeps
-[--model gemma] [--scenario 1 2] [--method best_of_n]``
+Usage: ``python -m consensus_tpu.cli.run_sweep --configs-root configs/appendix
+[--model gemma] [--scenario 1 2] [--method beam_search]``
+(``--configs-root configs/north_star`` runs the Gemma-2B timed tree.)
 """
 
 from __future__ import annotations
@@ -57,7 +58,7 @@ def find_config_files(
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(description="Run a config sweep")
-    parser.add_argument("--configs-root", default="configs/sweeps")
+    parser.add_argument("--configs-root", default="configs/appendix")
     parser.add_argument("--model", nargs="*", default=None)
     parser.add_argument("--scenario", nargs="*", type=int, default=None)
     parser.add_argument("--method", nargs="*", default=None)

@@ -1,0 +1,1 @@
+"""Bundled datasets (scenario text imported from the reference corpus)."""

@@ -106,6 +106,9 @@ class StatementEvaluator:
                 context=EVAL_SYSTEM_TEMPLATE.format(issue=issue, opinion=opinion),
                 continuation=statement,
                 chat=True,
+                # Reference parity: eval template in the system slot, the
+                # statement scored as user-turn content (evaluation.py:182).
+                role="user",
             )
             for _, opinion in agents
         ]

@@ -14,13 +14,17 @@ artifact contract:
   evaluation is deliberately post-hoc (:190-192);
 * results ordered and saved to ``results.csv`` (:334-380).
 
-Architectural change: no thread pool and no rate limiter.  The reference
-fans method×param combos across a ``ThreadPoolExecutor`` to hide HTTP
-latency behind a token-bucket ``APIRateLimiter`` (:26-62, 283-322); with an
-on-device backend the model IS the bottleneck and requests inside each
-method are already batched device calls, so runs execute sequentially and
-the concurrency/rate-limit config keys are accepted and recorded but unused
-(SURVEY §2.16's table maps them to device batching).
+Architectural change: no rate limiter, and the thread pool serves a
+DIFFERENT purpose.  The reference fans method×param combos across a
+``ThreadPoolExecutor`` to hide HTTP latency behind a token-bucket
+``APIRateLimiter`` (:26-62, 283-322).  Here ``concurrent_execution: true``
+(the same config key, default true like the reference :105-110) runs
+independent (seed × method × param) combos on worker threads whose backend
+calls MERGE into shared device batches via
+:class:`consensus_tpu.backends.batching.BatchingBackend` — the sweep's
+parallelism axis becomes device batch width (SURVEY §2.16).  Per-request
+PRNG keys keep results bit-identical to sequential execution.
+``api_rate_limit`` is accepted and recorded but unused on-device.
 """
 
 from __future__ import annotations
@@ -122,7 +126,13 @@ class Experiment:
 
     # -- execution -----------------------------------------------------------
 
-    def _run_one(self, method: str, run_config: Dict[str, Any], seed: int) -> Dict:
+    def _run_one(
+        self,
+        method: str,
+        run_config: Dict[str, Any],
+        seed: int,
+        backend: Optional[Backend] = None,
+    ) -> Dict:
         row: Dict[str, Any] = {
             "method": method,
             "seed": seed,
@@ -135,7 +145,7 @@ class Experiment:
         start = time.perf_counter()
         try:
             generator = get_method_generator(
-                method, self.backend, run_config, self.generation_model
+                method, backend or self.backend, run_config, self.generation_model
             )
             with get_tracer().span(f"generate/{method}"):
                 statement = generator.generate_statement(
@@ -154,11 +164,45 @@ class Experiment:
         return row
 
     def run(self) -> pd.DataFrame:
-        rows = []
+        runs: List[Dict[str, Any]] = []
         for i in range(self.num_seeds):
             seed = self.base_seed + i
-            logger.info("=== Seed %d (%d/%d) ===", seed, i + 1, self.num_seeds)
-            for run in self._run_configs(seed):
+            runs.extend(self._run_configs(seed))
+
+        concurrent = bool(self.config.get("concurrent_execution", True))
+        max_workers = int(self.config.get("max_concurrent_methods", 4))
+
+        if concurrent and len(runs) > 1 and max_workers > 1:
+            # Independent combos (all seeds flattened) share device batches
+            # through the BatchingBackend; results stay bit-identical to
+            # sequential execution (per-request PRNG keys).
+            from concurrent.futures import ThreadPoolExecutor
+
+            from consensus_tpu.backends.batching import BatchingBackend
+
+            batching = BatchingBackend(
+                self.backend,
+                flush_ms=float(self.config.get("batch_flush_ms", 10.0)),
+                expected_sessions=min(max_workers, len(runs)),
+            )
+
+            def worker(run):
+                with batching.session():
+                    logger.info("Running %s with %s", run["method"], run["config"])
+                    return self._run_one(
+                        run["method"], run["config"], run["seed"], backend=batching
+                    )
+
+            with ThreadPoolExecutor(max_workers=max_workers) as pool:
+                rows = list(pool.map(worker, runs))
+            self.last_batch_counts = dict(batching.batch_counts)
+            logger.info(
+                "Device batches issued: %s (%d runs, %d workers)",
+                batching.batch_counts, len(runs), max_workers,
+            )
+        else:
+            rows = []
+            for run in runs:
                 logger.info("Running %s with %s", run["method"], run["config"])
                 rows.append(self._run_one(run["method"], run["config"], run["seed"]))
 

@@ -129,7 +129,7 @@ class BeamSearchGenerator(BaseGenerator):
     ) -> List[List]:
         """One batched next-token call over all beams; k distinct candidates
         each (replaces the reference's rejection-sampling loop, :199-333)."""
-        system, user = reference_prompt(issue, agent_opinions)
+        system, user = reference_prompt(issue, agent_opinions, variant="beam_search")
         requests = [
             NextTokenRequest(
                 user_prompt=user + sequence,
@@ -164,7 +164,7 @@ class BeamSearchGenerator(BaseGenerator):
             for candidate in tokens:
                 layout.append((beam_idx, candidate.token))
                 for _, opinion in agents:
-                    a_system, a_user = agent_prompt(issue, opinion)
+                    a_system, a_user = agent_prompt(issue, opinion, variant="beam_search")
                     requests.append(
                         ScoreRequest(
                             context=a_user + sequence,

@@ -95,7 +95,7 @@ class FiniteLookaheadGenerator(BaseGenerator):
     ) -> List[List[str]]:
         """Grow the lookahead tree level by level — one batched call per
         level over the whole frontier — and return deduplicated token paths."""
-        system, user = reference_prompt(issue, agent_opinions)
+        system, user = reference_prompt(issue, agent_opinions, variant="finite_lookahead")
         frontier: List[List[str]] = [[]]  # token paths still growing
         finished: List[List[str]] = []
 
@@ -150,7 +150,7 @@ class FiniteLookaheadGenerator(BaseGenerator):
         requests = []
         for path in paths:
             for _, opinion in agents:
-                a_system, a_user = agent_prompt(issue, opinion)
+                a_system, a_user = agent_prompt(issue, opinion, variant="finite_lookahead")
                 requests.append(
                     ScoreRequest(
                         context=a_user + statement,

@@ -164,7 +164,7 @@ class MCTSGenerator(BaseGenerator):
         return child
 
     def _propose_tokens(self, statement: str, seed) -> List:
-        system, user = reference_prompt(self._issue, self._agent_opinions)
+        system, user = reference_prompt(self._issue, self._agent_opinions, variant="mcts")
         return self.backend.next_token_logprobs(
             [
                 NextTokenRequest(
@@ -185,9 +185,9 @@ class MCTSGenerator(BaseGenerator):
         logprob (one batched score call; reference :249-329)."""
         requests = [
             ScoreRequest(
-                context=agent_prompt(self._issue, opinion)[1] + statement,
+                context=agent_prompt(self._issue, opinion, variant="mcts")[1] + statement,
                 continuation=token,
-                system_prompt=agent_prompt(self._issue, opinion)[0],
+                system_prompt=agent_prompt(self._issue, opinion, variant="mcts")[0],
                 chat=False,
             )
             for _, opinion in self._agents
@@ -203,7 +203,7 @@ class MCTSGenerator(BaseGenerator):
         value the rolled-out statement as min over agents of its TOTAL
         logprob (reference :470-651; evaluated correctly — the reference
         crashes here, SURVEY §2.6)."""
-        system, user = reference_prompt(self._issue, self._agent_opinions)
+        system, user = reference_prompt(self._issue, self._agent_opinions, variant="mcts")
         rollout = self.backend.generate(
             [
                 GenerationRequest(
@@ -222,9 +222,9 @@ class MCTSGenerator(BaseGenerator):
 
         requests = [
             ScoreRequest(
-                context=agent_prompt(self._issue, opinion)[1],
+                context=agent_prompt(self._issue, opinion, variant="mcts")[1],
                 continuation=full_statement,
-                system_prompt=agent_prompt(self._issue, opinion)[0],
+                system_prompt=agent_prompt(self._issue, opinion, variant="mcts")[0],
                 chat=False,
             )
             for _, opinion in self._agents

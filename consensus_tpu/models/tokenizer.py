@@ -52,6 +52,8 @@ class Tokenizer(Protocol):
 
     def raw_prompt(self, user: str, system: Optional[str] = None) -> str: ...
 
+    def user_turn_prefix(self, system: Optional[str] = None) -> str: ...
+
     def token_ids_containing(self, text: str) -> List[int]: ...
 
 
@@ -124,6 +126,14 @@ class ByteTokenizer:
         # Reference raw-completions concatenation (src/utils.py:168-174).
         return f"{system}\n\n{user}" if system else user
 
+    def user_turn_prefix(self, system: Optional[str] = None) -> str:
+        """Chat template up to (and inside) the user-turn opening — for
+        scoring a continuation as user-turn content (ScoreRequest
+        role="user"; reference evaluation semantics src/evaluation.py:182)."""
+        if system:
+            return f"[SYS]{system}[/SYS]\n[USER]"
+        return "[USER]"
+
     def token_ids_containing(self, text: str) -> List[int]:
         """Substring-matched token ids (reference src/utils.py:122-134)."""
         ids = [
@@ -187,6 +197,19 @@ class HFTokenizer:
 
     def raw_prompt(self, user: str, system: Optional[str] = None) -> str:
         return f"{system}\n\n{user}" if system else user
+
+    def user_turn_prefix(self, system: Optional[str] = None) -> str:
+        if self.family == "gemma":
+            # No system role: the system text leads the user turn.
+            lead = f"{system}\n\n" if system else ""
+            return f"<start_of_turn>user\n{lead}"
+        parts = ["<|begin_of_text|>"]
+        if system:
+            parts.append(
+                f"<|start_header_id|>system<|end_header_id|>\n\n{system}<|eot_id|>"
+            )
+        parts.append("<|start_header_id|>user<|end_header_id|>\n\n")
+        return "".join(parts)
 
     @functools.lru_cache(maxsize=512)
     def token_ids_containing(self, text: str) -> List[int]:

@@ -1,0 +1,143 @@
+"""Extract the reference's committed AAMAS artifacts into a bundled dataset.
+
+DATA import (statements + measured welfare numbers, not code) from the
+reference's committed result CSVs under /root/reference/results/appendix/ —
+the measured quality baseline the TPU build must match (BASELINE.md).
+
+Produces ``consensus_tpu/data/aamas_baseline.json``:
+
+  {"runs": [{
+      "name": "aamas_gemma_scenario1_habermas_vs_bon_...",
+      "family": "gemma", "scenario": 1, "sweep": "habermas_vs_bon",
+      "rows": [{"method", "params": {...}, "seed", "statement",
+                "generation_time_s"}, ...],
+      "aggregate": [{"method", "params": {...},
+                     "egalitarian_welfare_perplexity_mean": {evaluator: x},
+                     "egalitarian_welfare_cosine_mean": {evaluator: x},
+                     "avg_rank_mean": x|null}, ...]}]}
+
+The A/B parity harness (consensus_tpu/cli/parity_report.py) re-scores these
+exact statements with the local backend and reports per-cell deltas against
+the bundled aggregates.  Run once from the repo root; the JSON is committed.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import re
+import sys
+
+import pandas as pd
+
+REF = pathlib.Path("/root/reference/results/appendix")
+OUT = pathlib.Path(__file__).resolve().parent.parent / (
+    "consensus_tpu/data/aamas_baseline.json"
+)
+
+RUN_RE = re.compile(r"aamas_(gemma|llama)_scenario(\d)_(.+)_\d{8}_\d{6}")
+
+#: Sweep-identifying params (reference IMPORTANT_PARAMETERS, utils.py:9-16).
+PARAM_COLUMNS = [
+    "param_n", "param_num_candidates", "param_num_rounds",
+    "param_branching_factor", "param_max_depth", "param_beam_width",
+]
+
+EVALUATORS = {
+    "google_gemma-2-9b-it": "gemma2-9b",
+    "meta-llama_Meta-Llama-3.1-8B-Instruct-Turbo": "llama3-8b",
+}
+
+
+def _params(row) -> dict:
+    out = {}
+    for col in PARAM_COLUMNS:
+        value = row.get(col)
+        if value is not None and not (isinstance(value, float) and math.isnan(value)):
+            out[col.removeprefix("param_")] = (
+                int(value) if float(value).is_integer() else float(value)
+            )
+    return out
+
+
+def extract_run(run_dir: pathlib.Path) -> dict | None:
+    match = RUN_RE.match(run_dir.name)
+    if not match:
+        return None
+    family, scenario, sweep = match.group(1), int(match.group(2)), match.group(3)
+
+    frame = pd.read_csv(run_dir / "results.csv")
+    rows = []
+    for _, row in frame.iterrows():
+        if isinstance(row.get("error_message"), str) and row["error_message"]:
+            continue
+        statement = row.get("statement")
+        if not isinstance(statement, str) or not statement.strip():
+            continue
+        rows.append(
+            {
+                "method": row["method"],
+                "params": _params(row),
+                "seed": int(row["seed"]),
+                "statement": statement,
+                "generation_time_s": float(row["generation_time_s"]),
+            }
+        )
+
+    aggregate = []
+    agg_file = run_dir / "evaluation/improved_aggregate/aggregated_metrics.csv"
+    if agg_file.exists():
+        agg = pd.read_csv(agg_file)
+        for _, row in agg.iterrows():
+            entry = {
+                "method": row["method"],
+                "params": _params(row),
+                "egalitarian_welfare_perplexity_mean": {},
+                "egalitarian_welfare_cosine_mean": {},
+            }
+            for column, model in EVALUATORS.items():
+                for metric in (
+                    "egalitarian_welfare_perplexity", "egalitarian_welfare_cosine"
+                ):
+                    value = row.get(f"{column}_{metric}_mean")
+                    if value is not None and not math.isnan(value):
+                        entry[f"{metric}_mean"][model] = round(float(value), 6)
+            rank = row.get("avg_rank_mean")
+            entry["avg_rank_mean"] = (
+                round(float(rank), 4)
+                if rank is not None and not math.isnan(rank)
+                else None
+            )
+            aggregate.append(entry)
+
+    return {
+        "name": run_dir.name,
+        "family": family,
+        "scenario": scenario,
+        "sweep": sweep,
+        "rows": rows,
+        "aggregate": aggregate,
+    }
+
+
+def main() -> None:
+    runs = []
+    for run_dir in sorted(REF.iterdir()):
+        if not run_dir.is_dir():
+            continue
+        entry = extract_run(run_dir)
+        if entry:
+            runs.append(entry)
+            print(
+                f"{run_dir.name}: {len(entry['rows'])} rows, "
+                f"{len(entry['aggregate'])} aggregate cells"
+            )
+    if not runs:
+        sys.exit("No runs found — is /root/reference mounted?")
+    OUT.write_text(json.dumps({"runs": runs}, indent=1))
+    print(f"Wrote {OUT} ({OUT.stat().st_size / 1e6:.2f} MB, {len(runs)} runs)")
+
+
+if __name__ == "__main__":
+    main()

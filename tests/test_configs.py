@@ -1,0 +1,137 @@
+"""AAMAS config-tree validation (VERDICT r1 #4).
+
+The committed tree must mirror the reference's sweep surface:
+configs/appendix/{gemma,llama}/scenario_{1..5}/{habermas_only,
+habermas_vs_best_of_n,beam_search,finite_lookahead}.yaml, plus
+configs/main_body/scenario_{1,2,3}.yaml and the MCTS example
+(reference run_aamas_experiments.py:21-90 glob surface).
+"""
+
+import itertools
+import pathlib
+
+import pytest
+import yaml
+
+from consensus_tpu.data.aamas_scenarios import MAIN_BODY, SCENARIOS
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+METHODS = ["habermas_only", "habermas_vs_best_of_n", "beam_search", "finite_lookahead"]
+
+
+def _load(path):
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+class TestAppendixTree:
+    @pytest.mark.parametrize(
+        "family,scenario,method",
+        list(itertools.product(["gemma", "llama"], range(1, 6), METHODS)),
+    )
+    def test_config_exists_and_valid(self, family, scenario, method):
+        path = REPO / "configs/appendix" / family / f"scenario_{scenario}" / f"{method}.yaml"
+        assert path.exists(), path
+        config = _load(path)
+        # Scenario text is the paper's exact survey data.
+        assert config["scenario"]["issue"] == SCENARIOS[scenario]["issue"]
+        assert (
+            config["scenario"]["agent_opinions"]
+            == SCENARIOS[scenario]["agent_opinions"]
+        )
+        assert config["num_seeds"] == 3
+        assert config["backend"] == "tpu"
+        for name in config["methods_to_run"]:
+            method_key = name if name in config else name
+            assert method_key in config, f"{name} section missing in {path}"
+
+    def test_reference_grid_parity(self):
+        """Spot-check the grids the paper sweeps (reference appendix YAMLs)."""
+        beam = _load(REPO / "configs/appendix/gemma/scenario_1/beam_search.yaml")
+        assert beam["beam_search"]["beam_width"] == [2, 4, 6, 8]
+        assert beam["beam_search"]["max_tokens"] == 50
+        assert beam["beam_search"]["brushup"] is True
+
+        look = _load(REPO / "configs/appendix/llama/scenario_3/finite_lookahead.yaml")
+        assert look["finite_lookahead"]["branching_factor"] == 3
+        assert look["finite_lookahead"]["max_depth"] == [1, 2, 3]
+
+        bon = _load(REPO / "configs/appendix/gemma/scenario_2/habermas_vs_best_of_n.yaml")
+        assert bon["best_of_n"]["n"] == [1, 3, 5, 10, 20, 50]
+        assert bon["habermas_machine"]["num_candidates"] == [1, 3, 5, 10, 20, 50]
+
+        hab = _load(REPO / "configs/appendix/llama/scenario_5/habermas_only.yaml")
+        assert hab["habermas_machine"]["num_candidates"] == [2, 5, 10]
+        assert hab["habermas_machine"]["num_rounds"] == [1, 2]
+
+    def test_family_models(self):
+        for scenario in range(1, 6):
+            gemma = _load(
+                REPO / f"configs/appendix/gemma/scenario_{scenario}/beam_search.yaml"
+            )
+            llama = _load(
+                REPO / f"configs/appendix/llama/scenario_{scenario}/beam_search.yaml"
+            )
+            assert gemma["models"]["generation_model"] == "gemma2-9b"
+            assert llama["models"]["generation_model"] == "llama3-8b"
+
+
+class TestMainBodyAndExamples:
+    @pytest.mark.parametrize("scenario", [1, 2, 3])
+    def test_main_body(self, scenario):
+        config = _load(REPO / f"configs/main_body/scenario_{scenario}.yaml")
+        assert config["scenario"]["issue"] == MAIN_BODY[scenario]["scenario"]["issue"]
+        assert set(config["methods_to_run"]) == {
+            "best_of_n", "finite_lookahead", "habermas_machine",
+            "predefined", "beam_search",
+        }
+        # The predefined control statement anchors cross-backend A/B parity.
+        assert (
+            config["predefined"]["predefined_statement"]
+            == MAIN_BODY[scenario]["predefined_statement"]
+        )
+
+    def test_mcts_example(self):
+        config = _load(REPO / "configs/examples/mcts.yaml")
+        assert config["methods_to_run"] == ["mcts"]
+        assert config["mcts"]["num_simulations"] == 3
+
+    def test_north_star_tree(self):
+        paths = sorted((REPO / "configs/north_star").glob("*/scenario_*/*.yaml"))
+        assert len(paths) == 20  # 5 scenarios x 4 method files
+        for path in paths:
+            config = _load(path)
+            assert config["backend_options"]["model"] == "gemma2-2b"
+            assert config["num_seeds"] == 5
+
+
+class TestSweepDriverDiscovery:
+    def test_find_config_files_filters(self):
+        from consensus_tpu.cli.run_sweep import find_config_files
+
+        all_appendix = find_config_files(str(REPO / "configs/appendix"))
+        assert len(all_appendix) == 40
+        gemma_only = find_config_files(
+            str(REPO / "configs/appendix"), models=["gemma"]
+        )
+        assert len(gemma_only) == 20
+        subset = find_config_files(
+            str(REPO / "configs/appendix"),
+            models=["llama"], scenarios=[2, 4], methods=["beam_search"],
+        )
+        assert len(subset) == 2
+
+    def test_experiment_accepts_appendix_config(self, tmp_path):
+        """An appendix config drives the experiment engine end-to-end on the
+        fake backend (grid expansion, param columns, run dir)."""
+        from consensus_tpu.backends.fake import FakeBackend
+        from consensus_tpu.experiment import Experiment
+
+        config = _load(REPO / "configs/appendix/gemma/scenario_1/habermas_only.yaml")
+        config["output_dir"] = str(tmp_path)
+        config["num_seeds"] = 1
+        config["habermas_machine"]["num_candidates"] = [2]
+        config["habermas_machine"]["num_rounds"] = [1]
+        frame = Experiment(config, backend=FakeBackend()).run()
+        assert len(frame) == 1
+        assert (frame["error_message"] == "").all()

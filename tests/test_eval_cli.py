@@ -1,0 +1,179 @@
+"""L5-L7 parity tail tests (VERDICT r1 #9): standalone evaluation CLI,
+--skip-llm-judge, OpenAI judge aliasing, aggregation column ordering."""
+
+import pandas as pd
+import pytest
+import yaml
+
+from consensus_tpu.aggregation import format_aggregated_columns
+from consensus_tpu.backends.api import JUDGE_MODEL_ALIASES, OpenAIBackend
+
+
+class TestOpenAIJudgeBackend:
+    def test_o3_aliases_to_gpt41(self):
+        backend = OpenAIBackend(model="o3")
+        assert backend.requested_model == "o3"
+        assert backend.model == "gpt-4.1"
+        assert JUDGE_MODEL_ALIASES == {"o3": "gpt-4.1"}
+
+    def test_other_models_pass_through(self):
+        assert OpenAIBackend(model="gpt-4-turbo").model == "gpt-4-turbo"
+
+    def test_degrades_to_sentinels_offline(self):
+        from consensus_tpu.backends.base import GenerationRequest, ScoreRequest
+
+        backend = OpenAIBackend()
+        result = backend.generate([GenerationRequest(user_prompt="hi")])[0]
+        assert not result.ok and result.text.startswith("[ERROR")
+        assert backend.score([ScoreRequest(context="a", continuation="b")])[0].ok is False
+        assert backend.next_token_logprobs([]) == []
+
+    def test_registered_in_get_backend(self):
+        from consensus_tpu.backends import get_backend
+
+        backend = get_backend("openai", model="o3")
+        assert isinstance(backend, OpenAIBackend)
+
+
+class TestEvaluateCli:
+    def test_statements_file_path(self, tmp_path):
+        from consensus_tpu.cli.evaluate import main
+
+        config = {
+            "scenario": {
+                "issue": "Should X happen?",
+                "agent_opinions": {"A": "Yes.", "B": "No."},
+            }
+        }
+        config_path = tmp_path / "cfg.yaml"
+        config_path.write_text(yaml.safe_dump(config))
+        statements_path = tmp_path / "statements.yaml"
+        statements_path.write_text(
+            yaml.safe_dump({"m1": "Statement one here.", "m2": "Another one."})
+        )
+        out = tmp_path / "out"
+        rc = main(
+            [
+                "--config", str(config_path),
+                "--statements-file", str(statements_path),
+                "--backend", "fake",
+                "--output-dir", str(out),
+                "--quiet",
+            ]
+        )
+        assert rc == 0
+        frame = pd.read_csv(out / "evaluation_results.csv")
+        assert set(frame["method"]) == {"m1", "m2"}
+        assert "egalitarian_welfare_perplexity" in frame.columns
+
+    def test_results_file_path(self, tmp_path):
+        from consensus_tpu.backends.fake import FakeBackend
+        from consensus_tpu.cli.evaluate import main
+        from consensus_tpu.experiment import Experiment
+
+        config = {
+            "experiment_name": "cli_eval",
+            "seed": 1,
+            "scenario": {
+                "issue": "Should X happen?",
+                "agent_opinions": {"A": "Yes.", "B": "No."},
+            },
+            "methods_to_run": ["zero_shot"],
+            "zero_shot": {"max_tokens": 8},
+            "output_dir": str(tmp_path),
+        }
+        experiment = Experiment(config, backend=FakeBackend())
+        experiment.run()
+        rc = main(
+            [
+                "--results-file", str(tmp_path / experiment.run_dir.name / "results.csv")
+                if hasattr(experiment.run_dir, "name")
+                else str(experiment.run_dir) + "/results.csv",
+                "--backend", "fake",
+                "--quiet",
+            ]
+        )
+        assert rc == 0
+
+    def test_requires_input(self, capsys):
+        from consensus_tpu.cli.evaluate import main
+
+        with pytest.raises(SystemExit):
+            main(["--backend", "fake"])
+
+
+class TestSkipLlmJudgeFlag:
+    def test_flag_accepted_and_pipeline_runs(self, tmp_path):
+        from consensus_tpu.cli.run_experiment_with_eval import main
+
+        config = {
+            "experiment_name": "skipjudge",
+            "seed": 1,
+            "backend": "fake",
+            "scenario": {
+                "issue": "Should X happen?",
+                "agent_opinions": {"A": "Yes.", "B": "No."},
+            },
+            "methods_to_run": ["zero_shot", "predefined"],
+            "zero_shot": {"max_tokens": 8},
+            "predefined": {"predefined_statement": "We will pilot it."},
+            "output_dir": str(tmp_path),
+        }
+        config_path = tmp_path / "cfg.yaml"
+        config_path.write_text(yaml.safe_dump(config))
+        rc = main(
+            [
+                "-c", str(config_path),
+                "--skip-llm-judge",
+                "--skip-comparative-ranking",
+                "--quiet",
+            ]
+        )
+        assert rc == 0
+        run_dirs = [d for d in tmp_path.iterdir() if d.name.startswith("skipjudge")]
+        assert run_dirs
+        eval_csvs = list(run_dirs[0].glob("evaluation/*/seed_0/evaluation_results.csv"))
+        assert eval_csvs
+        frame = pd.read_csv(eval_csvs[0])
+        # Judge skipped: no judge-score columns in standard evaluation.
+        assert not any(c.startswith("judge_score_") for c in frame.columns)
+
+
+class TestAggregationBeautifier:
+    def test_column_ordering(self):
+        frame = pd.DataFrame(
+            [
+                {
+                    "zzz_extra": 1.0,
+                    "modelA_egalitarian_welfare_perplexity_std": 0.1,
+                    "modelA_egalitarian_welfare_perplexity_mean": 5.0,
+                    "avg_rank_mean": 2.0,
+                    "modelA_cosine_similarity_Agent 1_mean": 0.5,
+                    "modelA_egalitarian_welfare_cosine_mean": 0.4,
+                    "param_n": 3,
+                    "method_with_params": "best_of_n (n=3)",
+                    "method": "best_of_n",
+                    "modelA_utilitarian_welfare_perplexity_mean": 9.0,
+                }
+            ]
+        )
+        ordered = list(format_aggregated_columns(frame).columns)
+        assert ordered[:3] == ["method", "method_with_params", "param_n"]
+        # perplexity family first: egalitarian (mean before std) then
+        # utilitarian; then cosine family (egalitarian before agent);
+        # then rank; unmatched trail.
+        assert ordered[3:] == [
+            "modelA_egalitarian_welfare_perplexity_mean",
+            "modelA_egalitarian_welfare_perplexity_std",
+            "modelA_utilitarian_welfare_perplexity_mean",
+            "modelA_egalitarian_welfare_cosine_mean",
+            "modelA_cosine_similarity_Agent 1_mean",
+            "avg_rank_mean",
+            "zzz_extra",
+        ]
+
+    def test_roundtrip_no_loss(self):
+        frame = pd.DataFrame([{"method": "m", "a_perplexity_mean": 1.0, "x": 2}])
+        out = format_aggregated_columns(frame)
+        assert set(out.columns) == set(frame.columns)
+        assert out.iloc[0]["a_perplexity_mean"] == 1.0

@@ -1,0 +1,116 @@
+"""Quality-parity harness tests (VERDICT r1 #3) + role="user" scoring."""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.cli.parity_report import (
+    build_report,
+    load_baseline,
+    render_markdown,
+    score_statements_batched,
+)
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return FakeBackend()
+
+
+class TestBaselineBundle:
+    def test_bundle_loads_with_expected_shape(self):
+        data = load_baseline()
+        assert len(data["runs"]) == 20  # 5 scenarios x 4 sweeps (gemma)
+        scenarios = {r["scenario"] for r in data["runs"]}
+        sweeps = {r["sweep"] for r in data["runs"]}
+        assert scenarios == {1, 2, 3, 4, 5}
+        assert sweeps == {
+            "beam_search", "finite_lookahead", "habermas_only", "habermas_vs_bon",
+        }
+        run = next(
+            r for r in data["runs"]
+            if r["scenario"] == 1 and r["sweep"] == "habermas_vs_bon"
+        )
+        assert len(run["rows"]) == 36  # 12 cells x 3 seeds
+        # BASELINE.md pins these exact aggregates.
+        bon50 = next(
+            a for a in run["aggregate"]
+            if a["method"] == "best_of_n" and a["params"].get("n") == 50
+        )
+        assert bon50["egalitarian_welfare_perplexity_mean"]["gemma2-9b"] == (
+            pytest.approx(5.569077, abs=1e-4)
+        )
+
+    def test_statements_are_real_text(self):
+        data = load_baseline()
+        for run in data["runs"][:3]:
+            for row in run["rows"][:2]:
+                assert len(row["statement"].split()) >= 3
+
+
+class TestScoring:
+    def test_batched_scoring_matches_per_statement(self, backend):
+        statements = ["We should balance privacy and research.", "Another view."]
+        opinions = {"A": "Privacy first.", "B": "Research matters."}
+        batched = score_statements_batched(
+            backend, statements, "Issue?", opinions
+        )
+        singles = [
+            score_statements_batched(backend, [s], "Issue?", opinions)[0]
+            for s in statements
+        ]
+        for b, s in zip(batched, singles):
+            assert b["egalitarian_welfare_perplexity"] == pytest.approx(
+                s["egalitarian_welfare_perplexity"], rel=1e-6
+            )
+            assert b["egalitarian_welfare_cosine"] == pytest.approx(
+                s["egalitarian_welfare_cosine"], rel=1e-6
+            )
+
+    def test_report_structure_and_deltas(self, backend):
+        report = build_report(
+            backend, scenarios=[1], sweeps=["finite_lookahead"], weights="fake"
+        )
+        assert report["n_cells"] == 3  # depth in {1,2,3}
+        for cell in report["cells"]:
+            assert cell["baseline_egalitarian_perplexity"] is not None
+            assert "perplexity_delta_pct" in cell
+            expected = (
+                100.0
+                * (
+                    cell["local_egalitarian_perplexity"]
+                    - cell["baseline_egalitarian_perplexity"]
+                )
+                / cell["baseline_egalitarian_perplexity"]
+            )
+            assert cell["perplexity_delta_pct"] == pytest.approx(expected, abs=0.01)
+        markdown = render_markdown(report)
+        assert "finite_lookahead" in markdown
+        assert str(report["mean_abs_perplexity_delta_pct"]) in markdown
+
+
+class TestUserRoleScoring:
+    def test_user_turn_prefix_templates(self):
+        from consensus_tpu.models.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        assert tok.user_turn_prefix("SYS") == "[SYS]SYS[/SYS]\n[USER]"
+        assert tok.user_turn_prefix() == "[USER]"
+
+    def test_role_user_differs_from_assistant_on_tpu_backend(self):
+        from consensus_tpu.backends.base import ScoreRequest
+        from consensus_tpu.backends.tpu import TPUBackend
+
+        backend = TPUBackend(model="tiny-gemma2", max_context=128)
+        template = "Here is a consensus statement about the issue."
+        as_user = backend.score(
+            [ScoreRequest(context=template, continuation=" Privacy matters.",
+                          chat=True, role="user")]
+        )[0]
+        as_assistant = backend.score(
+            [ScoreRequest(context=template, continuation=" Privacy matters.",
+                          chat=True)]
+        )[0]
+        assert as_user.ok and as_assistant.ok
+        # Different conditioning prefixes -> different distributions.
+        assert not np.allclose(as_user.logprobs, as_assistant.logprobs)

@@ -1,0 +1,85 @@
+"""Prompt-template parity tests (VERDICT r1 #10).
+
+Pins each decoder's templates to the reference's exact strings — the
+welfare numbers are sensitive to them (SURVEY §7.3):
+best_of_n.py:29-35, beam_search.py:58-80, finite_lookahead.py:20-34,
+mcts.py:55-77, opinions block best_of_n.py:89-94.
+"""
+
+from consensus_tpu.methods.prompts import (
+    agent_prompt,
+    format_opinions,
+    reference_prompt,
+)
+
+ISSUE = "Should X happen?"
+OPINIONS = {"Agent 1": "Yes.", "Agent 2": "No."}
+
+
+def test_opinions_block_is_reference_format():
+    assert format_opinions(OPINIONS) == "Participant 1: Yes.\n\nParticipant 2: No."
+
+
+def test_best_of_n_templates():
+    system, user = reference_prompt(ISSUE, OPINIONS, variant="best_of_n")
+    assert user == (
+        "Issue: Should X happen?\n\n"
+        "Participants' opinions:\n"
+        "Participant 1: Yes.\n\nParticipant 2: No.\n\n"
+        "Consensus statement (less than 50 tokens): "
+    )
+    assert system.startswith(
+        "You are generating a consensus statement that represents the views "
+        "of multiple participants.\n"
+    )
+    assert system.endswith("ONLY WRITE THE STATEMENT AND NOTHING ELSE.")
+
+    a_system, a_user = agent_prompt(ISSUE, "Yes.", variant="best_of_n")
+    assert a_user == (
+        "Issue: Should X happen?\n\n"
+        "Agent's opinion:\nYes.\n\n"
+        "Statement reflecting this opinion (less than 50 tokens): "
+    )
+    assert a_system.startswith(
+        "You are generating a statement that represents the views of a "
+        "single participant.\n"
+    )
+
+
+def test_beam_search_newline_form_and_participant_wording():
+    _, user = reference_prompt(ISSUE, OPINIONS, variant="beam_search")
+    assert user.startswith("Issue:\nShould X happen?\n\n")
+    assert user.endswith("Consensus statement (less than 50 tokens):\n")
+
+    _, a_user = agent_prompt(ISSUE, "Yes.", variant="beam_search")
+    assert "Participant's opinion:\nYes.\n\n" in a_user
+    assert a_user.endswith(
+        "Statement reflecting ONLY this participant's opinion "
+        "(less than 50 tokens):\n"
+    )
+
+
+def test_finite_lookahead_mixes_newline_form_with_agent_wording():
+    _, user = reference_prompt(ISSUE, OPINIONS, variant="finite_lookahead")
+    assert user.startswith("Issue:\n")
+    _, a_user = agent_prompt(ISSUE, "Yes.", variant="finite_lookahead")
+    assert "Agent's opinion:\nYes.\n\n" in a_user
+    assert a_user.endswith("Statement reflecting this opinion (less than 50 tokens):\n")
+
+
+def test_mcts_coherent_system_and_no_token_cap():
+    system, user = reference_prompt(ISSUE, OPINIONS, variant="mcts")
+    assert "Be concise and coherent." in system
+    assert "ONLY WRITE THE CONSENSUS STATEMENT AND NOTHING ELSE." in system
+    assert "less than 50 tokens" not in user
+    assert user.endswith("Consensus statement:\n")
+
+    a_system, a_user = agent_prompt(ISSUE, "Yes.", variant="mcts")
+    assert "Be concise and coherent." in a_system
+    assert a_user.endswith("Statement reflecting ONLY this participant's opinion:\n")
+
+
+def test_default_variant_is_best_of_n():
+    assert reference_prompt(ISSUE, OPINIONS) == reference_prompt(
+        ISSUE, OPINIONS, variant="best_of_n"
+    )
