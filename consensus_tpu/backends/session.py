@@ -1,0 +1,161 @@
+"""Token-search sessions: stateful propose-and-score for token-level decoders.
+
+A session fixes the search context once — the reference-policy prompt and
+the per-agent prompts (same model, different prefix: SURVEY §0) — and then
+serves the decoders' per-step primitive:
+
+    propose k next tokens per active slot from the reference policy, and
+    score every proposal under every agent policy.
+
+Two implementations:
+
+* :class:`PrefixTokenSearchSession` — backend-agnostic fallback.  Each step
+  re-submits full prefixes through ``Backend.next_token_logprobs`` +
+  ``Backend.score`` (exactly round 1's beam-search data flow; works on
+  fake/API backends).  O(T^2) total model work.
+* :class:`TPUTokenSearchSession` (constructed by
+  ``TPUBackend.open_token_search``) — persistent per-(slot x role) KV caches
+  on device; each step is ONE fused program (models/stepper.py).  O(T).
+
+Semantics note: the fallback re-tokenizes ``prompt + sequence_string`` every
+step (the reference's behavior — its "sequence" is a string of API token
+strings, beam_search.py:433-435), while the TPU session appends token *ids*
+to persistent caches — the true token-level-MDP state.  The two coincide
+except when a tokenizer would merge a sequence boundary on re-encoding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+from consensus_tpu.backends.base import (
+    BAN_BIAS,
+    NextTokenRequest,
+    ScoreRequest,
+)
+
+
+class ScoredCandidate(NamedTuple):
+    token: str
+    token_id: int
+    ref_logprob: float  # proposal logprob under the reference policy
+    agent_logprobs: Tuple[float, ...]  # one per agent, search-order
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchSpec:
+    """Immutable description of one token search."""
+
+    ref_system: Optional[str]
+    ref_user: str
+    agent_prompts: Tuple[Tuple[Optional[str], str], ...]  # (system, user) per agent
+    n_slots: int
+    k: int
+    temperature: float = 1.0
+    seed: Optional[int] = None
+    sample: bool = True  # Gumbel-top-k vs deterministic top-k proposals
+    bias_against_tokens: Tuple[str, ...] = ()
+    bias_value: float = BAN_BIAS
+    max_steps: int = 64
+    failure_logprob: float = -10.0  # substituted when a backend scores nothing
+
+
+class PrefixTokenSearchSession:
+    """Fallback session: full-prefix batched calls per step (any backend)."""
+
+    def __init__(self, backend, spec: SearchSpec):
+        self.backend = backend
+        self.spec = spec
+        self._sequences = [""] * spec.n_slots
+        self._step = 0
+
+    # -- protocol ------------------------------------------------------------
+
+    def propose(self) -> List[List[ScoredCandidate]]:
+        """Root proposals (every slot starts with the empty sequence)."""
+        return self._propose_and_score()
+
+    def advance_and_propose(
+        self, parents: Sequence[int], chosen: Sequence[ScoredCandidate]
+    ) -> List[List[ScoredCandidate]]:
+        """Advance slot i to ``parents[i]``'s sequence + ``chosen[i]``, then
+        propose and score for the new state of every slot."""
+        spec = self.spec
+        if len(parents) != spec.n_slots or len(chosen) != spec.n_slots:
+            raise ValueError(
+                f"expected {spec.n_slots} (parent, token) pairs, got "
+                f"{len(parents)}/{len(chosen)}"
+            )
+        self._sequences = [
+            self._sequences[parent] + cand.token
+            for parent, cand in zip(parents, chosen)
+        ]
+        self._step += 1
+        return self._propose_and_score()
+
+    # -- internals -----------------------------------------------------------
+
+    def _propose_and_score(self) -> List[List[ScoredCandidate]]:
+        spec = self.spec
+        seed = spec.seed
+        requests = [
+            NextTokenRequest(
+                user_prompt=spec.ref_user + sequence,
+                system_prompt=spec.ref_system,
+                k=spec.k,
+                temperature=spec.temperature,
+                seed=((seed + self._step) * 1000 + slot) if seed is not None else None,
+                mode="sample" if spec.sample else "topk",
+                bias_against_tokens=spec.bias_against_tokens,
+                bias_value=spec.bias_value,
+                chat=False,
+            )
+            for slot, sequence in enumerate(self._sequences)
+        ]
+        proposals = self.backend.next_token_logprobs(requests)
+
+        score_requests = []
+        for sequence, candidates in zip(self._sequences, proposals):
+            for candidate in candidates:
+                for a_system, a_user in spec.agent_prompts:
+                    score_requests.append(
+                        ScoreRequest(
+                            context=a_user + sequence,
+                            continuation=candidate.token,
+                            system_prompt=a_system,
+                            chat=False,
+                        )
+                    )
+        scores = self.backend.score(score_requests)
+
+        n_agents = len(spec.agent_prompts)
+        out: List[List[ScoredCandidate]] = []
+        flat = 0
+        for candidates in proposals:
+            slot_out = []
+            for candidate in candidates:
+                agent_lps = tuple(
+                    (s.logprobs[-1] if s.ok else spec.failure_logprob)
+                    for s in scores[flat : flat + n_agents]
+                )
+                flat += n_agents
+                slot_out.append(
+                    ScoredCandidate(
+                        token=candidate.token,
+                        token_id=candidate.token_id,
+                        ref_logprob=candidate.logprob,
+                        agent_logprobs=agent_lps,
+                    )
+                )
+            out.append(slot_out)
+        return out
+
+
+def open_token_search(backend, spec: SearchSpec):
+    """Session factory: a backend's own ``open_token_search`` wins (TPU);
+    everything else gets the full-prefix fallback."""
+    maker = getattr(backend, "open_token_search", None)
+    if maker is not None:
+        return maker(spec)
+    return PrefixTokenSearchSession(backend, spec)

@@ -63,6 +63,10 @@ logger = logging.getLogger(__name__)
 #: Above this vocab size the streaming scorer replaces full-logit scoring.
 _STREAMED_VOCAB_THRESHOLD = 32_768
 
+#: Search-session KV caches above this (plus resident weights) risk HBM
+#: exhaustion — fall back to the cacheless full-prefix session instead.
+_SESSION_CACHE_BYTES_CAP = 8 * 1024**3
+
 
 def _bucket(n: int, minimum: int = 32) -> int:
     size = minimum
@@ -488,6 +492,33 @@ class TPUBackend:
             )
         return out
 
+    # -- token-search sessions -------------------------------------------------
+
+    def open_token_search(self, spec):
+        """Incremental KV-cache search session (models/stepper.py): one fused
+        device program per emitted token instead of re-running every prefix.
+        Falls back to the generic full-prefix session when the persistent
+        caches wouldn't fit alongside the weights."""
+        from consensus_tpu.backends.session import PrefixTokenSearchSession
+
+        c = self.config
+        n_rows = spec.n_slots * (1 + len(spec.agent_prompts))
+        # Upper bound before tokenizing: prefix bucket <= max_context, plus
+        # one cache column per step, at the cache's actual dtype width.
+        width_guess = self.max_context + spec.max_steps
+        itemsize = jnp.dtype(self.params["embed"].dtype).itemsize
+        cache_bytes = (
+            2 * c.n_layers * n_rows * width_guess * c.n_kv_heads * c.head_dim
+            * itemsize
+        )
+        if cache_bytes > _SESSION_CACHE_BYTES_CAP:
+            logger.warning(
+                "open_token_search: %d-row cache (~%.1f GB) over cap — using "
+                "full-prefix fallback session", n_rows, cache_bytes / 1e9,
+            )
+            return PrefixTokenSearchSession(self, spec)
+        return TPUTokenSearchSession(self, spec)
+
     # -- embeddings ------------------------------------------------------------
 
     def embed(self, texts: Sequence[str]) -> np.ndarray:
@@ -520,3 +551,131 @@ def _embed_forward(params, config: ModelConfig, tokens, valid):
         mask.sum(1), 1.0
     )
     return pooled
+
+
+class TPUTokenSearchSession:
+    """Incremental token search over persistent per-(slot x role) KV caches.
+
+    Rows are beam-major: slot b occupies rows [b*(1+A), (b+1)*(1+A)) with
+    role 0 = reference policy and roles 1..A = agent policies.  Each
+    ``advance_and_propose`` is ONE fused device call (models/stepper.py):
+    gather surviving parents' cache rows, append the chosen token id,
+    forward one position, Gumbel-top-k the reference rows, and gather the
+    proposal ids from the agents' log-softmax — O(T) total model work where
+    the full-prefix data flow is O(T^2).
+
+    State is token *ids* (the true token-level-MDP state); the decoded
+    strings in returned candidates are for host-side semantics (EOS sets,
+    dedup, display).
+    """
+
+    def __init__(self, backend: "TPUBackend", spec):
+        self.backend = backend
+        self.spec = spec
+        tok = backend.tokenizer
+        prefixes = [tok.raw_prompt(spec.ref_user, spec.ref_system)] + [
+            tok.raw_prompt(a_user, a_system)
+            for a_system, a_user in spec.agent_prompts
+        ]
+        token_lists = [tok.encode(p, add_bos=True) for p in prefixes]
+        max_prefix = backend.max_context - spec.max_steps
+        token_lists = [ids[-max_prefix:] for ids in token_lists]
+        self._tokens, self._valid = backend._left_pad_batch(token_lists)
+        self._w0 = int(self._tokens.shape[1])
+        self.n_roles = len(prefixes)
+        self._step = 0
+        self._cache = None
+        self._cur_pos = None
+        bias = backend._bias_vector(spec.bias_against_tokens, spec.bias_value)
+        self._ref_bias = jnp.asarray(bias) if bias is not None else None
+        # One base key per session; per-(step, slot) keys fold in-device so a
+        # step ships no key material.  Unseeded sessions draw a fresh nonce
+        # (each session serves exactly one statement).
+        if spec.seed is None:
+            backend._unseeded_calls += 1
+            self._base_key = backend._fold_seed(
+                "search", "unseeded", backend._unseeded_calls
+            )
+        else:
+            self._base_key = backend._fold_seed("search", spec.seed)
+        self._temperature = jnp.asarray(spec.temperature, jnp.float32)
+
+    # -- protocol ------------------------------------------------------------
+
+    def propose(self) -> List[List["ScoredCandidate"]]:
+        from consensus_tpu.models.stepper import search_prefill
+
+        spec = self.spec
+        out = search_prefill(
+            self.backend.params, self.backend.config,
+            self._tokens, self._valid,
+            spec.n_slots, self.n_roles,
+            self._base_key, self._temperature,
+            spec.k, spec.sample, spec.max_steps,
+            ref_bias=self._ref_bias,
+        )
+        return self._finish(out)
+
+    def advance_and_propose(
+        self, parents: Sequence[int], chosen: Sequence
+    ) -> List[List["ScoredCandidate"]]:
+        from consensus_tpu.models.stepper import search_step
+
+        spec = self.spec
+        if len(parents) != spec.n_slots or len(chosen) != spec.n_slots:
+            raise ValueError(
+                f"expected {spec.n_slots} (parent, token) pairs, got "
+                f"{len(parents)}/{len(chosen)}"
+            )
+        if self._step >= spec.max_steps:
+            raise ValueError(f"session exhausted its {spec.max_steps} steps")
+        self._step += 1
+        # One packed H2D array and one packed D2H fetch per step: every
+        # host<->device round-trip rides a tunneled relay (~90 ms RTT), so
+        # scalar-by-scalar shipping would dominate the whole search.
+        advance = np.stack(
+            [
+                np.asarray(list(parents), np.int32),
+                np.asarray([c.token_id for c in chosen], np.int32),
+            ]
+        )
+        step_meta = np.asarray(
+            [self._step, self._w0 + self._step - 1], np.int32
+        )
+        out = search_step(
+            self.backend.params, self.backend.config,
+            self._cache, self._cur_pos,
+            jnp.asarray(advance), jnp.asarray(step_meta),
+            spec.n_slots, self.n_roles,
+            self._base_key, self._temperature,
+            spec.k, spec.sample,
+            ref_bias=self._ref_bias,
+        )
+        return self._finish(out)
+
+    # -- internals -----------------------------------------------------------
+
+    def _finish(self, out) -> List[List["ScoredCandidate"]]:
+        from consensus_tpu.backends.session import ScoredCandidate
+
+        self._cache = out.cache
+        self._cur_pos = out.cur_pos
+        packed = np.asarray(out.packed)  # (B, k, 2 + A)
+        tok = self.backend.tokenizer
+        results = []
+        for slot in range(self.spec.n_slots):
+            slot_out = []
+            for j in range(self.spec.k):
+                token_id = int(packed[slot, j, 0])
+                slot_out.append(
+                    ScoredCandidate(
+                        token=tok.token_str(token_id),
+                        token_id=token_id,
+                        ref_logprob=float(packed[slot, j, 1]),
+                        agent_logprobs=tuple(
+                            float(v) for v in packed[slot, j, 2:]
+                        ),
+                    )
+                )
+            results.append(slot_out)
+        return results

@@ -1,4 +1,4 @@
-"""Token-level egalitarian beam search, batched per step.
+"""Token-level egalitarian beam search over an incremental search session.
 
 Reference: ``src/methods/beam_search.py`` (695 LoC; SURVEY §2.4/§3.3).  Same
 search semantics:
@@ -20,17 +20,23 @@ search semantics:
 
 Cost redesign (the reason this exists): the reference spends
 ``max_tokens x beam_width x (attempts + beam_width x agents)`` sequential
-API calls per statement — 4 000–5 100 s measured (SURVEY §6).  Here each
-step is exactly TWO batched backend calls: one ``next_token_logprobs`` over
-all beams (exact top-k/Gumbel-k from the true distribution — no rejection
-sampling), and one ``score`` over all (beam x token x agent) triples.
+API calls per statement — 4 000–5 100 s measured (SURVEY §6).  Here the
+whole search runs through ONE token-search session
+(consensus_tpu/backends/session.py): on the TPU backend every step is a
+single fused device program over persistent per-(beam x agent) KV caches —
+proposal top-k and all (beam x token x agent) scores come out of the same
+one-position forward.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
-from consensus_tpu.backends.base import NextTokenRequest, ScoreRequest
+from consensus_tpu.backends.session import (
+    ScoredCandidate,
+    SearchSpec,
+    open_token_search,
+)
 from consensus_tpu.methods.base import BaseGenerator
 from consensus_tpu.methods.brushup import brushup_statement_ending
 from consensus_tpu.methods.prompts import agent_prompt, reference_prompt
@@ -69,7 +75,8 @@ BIAS_AGAINST_TOKENS = (
 DEFAULT_FAILURE_REWARD = -10.0  # reference :384,404
 MIN_WORDS = 5  # reference :630-643
 
-Beam = Tuple[str, List[float]]
+#: (sequence string, cumulative per-agent rewards, session slot index)
+Beam = Tuple[str, List[float], int]
 
 
 class BeamSearchGenerator(BaseGenerator):
@@ -88,21 +95,61 @@ class BeamSearchGenerator(BaseGenerator):
         if not agents:
             return ""
 
-        beams: List[Beam] = [("", [0.0] * len(agents))]
-        completed: List[Beam] = []
+        system, user = reference_prompt(issue, agent_opinions, variant="beam_search")
+        agent_prompts = tuple(
+            agent_prompt(issue, opinion, variant="beam_search")
+            for _, opinion in agents
+        )
+        session = open_token_search(
+            self.backend,
+            SearchSpec(
+                ref_system=system,
+                ref_user=user,
+                agent_prompts=agent_prompts,
+                n_slots=beam_width,
+                k=beam_width,
+                temperature=temperature,
+                seed=seed,
+                sample=True,
+                bias_against_tokens=bias_tokens if use_biasing else (),
+                bias_value=bias_value,
+                max_steps=max_tokens,
+                failure_logprob=DEFAULT_FAILURE_REWARD,
+            ),
+        )
+
+        beams: List[Beam] = [("", [0.0] * len(agents), 0)]
+        completed: List[Tuple[str, List[float]]] = []
+        proposals = session.propose()
 
         for step in range(max_tokens):
-            if not beams:
-                break
-            proposals = self._propose_tokens(
-                issue, agent_opinions, beams, beam_width, temperature,
-                bias_tokens if use_biasing else (), bias_value,
-                seed=(seed + step) if seed is not None else None,
-            )
-            candidates = self._score_candidates(issue, agents, beams, proposals)
+            candidates = []  # (new_sequence, new_rewards, candidate, parent_slot)
+            for sequence, cum_rewards, slot in beams:
+                for cand in proposals[slot]:
+                    new_rewards = [
+                        c + r for c, r in zip(cum_rewards, cand.agent_logprobs)
+                    ]
+                    candidates.append(
+                        (sequence + cand.token, new_rewards, cand, slot)
+                    )
             beams, completed = self._prune(candidates, completed, beam_width)
+            if not beams or step == max_tokens - 1:
+                break
+            # Advance every session slot; slots beyond the surviving beams
+            # repeat the last survivor and their proposals are ignored.
+            parents: List[int] = []
+            chosen: List[ScoredCandidate] = []
+            new_beams: List[Beam] = []
+            for i in range(beam_width):
+                sequence, rewards, cand, parent = beams[min(i, len(beams) - 1)]
+                parents.append(parent)
+                chosen.append(cand)
+                if i < len(beams):
+                    new_beams.append((sequence, rewards, i))
+            proposals = session.advance_and_propose(parents, chosen)
+            beams = new_beams
 
-        completed.extend(beams)
+        completed.extend((seq, rewards) for seq, rewards, *_ in beams)
         if not completed:
             return ""
 
@@ -116,101 +163,31 @@ class BeamSearchGenerator(BaseGenerator):
 
     # -- steps ---------------------------------------------------------------
 
-    def _propose_tokens(
-        self,
-        issue: str,
-        agent_opinions: Dict[str, str],
-        beams: List[Beam],
-        k: int,
-        temperature: float,
-        bias_tokens: Tuple[str, ...],
-        bias_value: float,
-        seed,
-    ) -> List[List]:
-        """One batched next-token call over all beams; k distinct candidates
-        each (replaces the reference's rejection-sampling loop, :199-333)."""
-        system, user = reference_prompt(issue, agent_opinions, variant="beam_search")
-        requests = [
-            NextTokenRequest(
-                user_prompt=user + sequence,
-                system_prompt=system,
-                k=k,
-                temperature=temperature,
-                seed=(seed * 1000 + i) if seed is not None else None,
-                mode="sample",
-                bias_against_tokens=bias_tokens,
-                bias_value=bias_value,
-                chat=False,  # raw-completions continuation (reference :231-234)
-            )
-            for i, (sequence, _) in enumerate(beams)
-        ]
-        return self.backend.next_token_logprobs(requests)
-
-    def _score_candidates(
-        self,
-        issue: str,
-        agents: List[Tuple[str, str]],
-        beams: List[Beam],
-        proposals: List[List],
-    ) -> List[Tuple[str, List[float], str]]:
-        """One batched score call over every (beam, token, agent) triple.
-
-        Agent reward for a token = its logprob after the agent context +
-        current sequence (reference _get_agent_token_logprob, :335-405).
-        """
-        requests = []
-        layout = []  # (beam_idx, token_str)
-        for beam_idx, ((sequence, _), tokens) in enumerate(zip(beams, proposals)):
-            for candidate in tokens:
-                layout.append((beam_idx, candidate.token))
-                for _, opinion in agents:
-                    a_system, a_user = agent_prompt(issue, opinion, variant="beam_search")
-                    requests.append(
-                        ScoreRequest(
-                            context=a_user + sequence,
-                            continuation=candidate.token,
-                            system_prompt=a_system,
-                            chat=False,
-                        )
-                    )
-        results = self.backend.score(requests)
-
-        n_agents = len(agents)
-        candidates = []
-        for i, (beam_idx, token) in enumerate(layout):
-            sequence, cum_rewards = beams[beam_idx]
-            scores = results[i * n_agents : (i + 1) * n_agents]
-            token_rewards = [
-                (s.logprobs[-1] if s.ok else DEFAULT_FAILURE_REWARD) for s in scores
-            ]
-            new_rewards = [c + r for c, r in zip(cum_rewards, token_rewards)]
-            candidates.append((sequence + token, new_rewards, token))
-        return candidates
-
     @staticmethod
     def _prune(
-        candidates: List[Tuple[str, List[float], str]],
-        completed: List[Beam],
+        candidates: List[Tuple[str, List[float], ScoredCandidate, int]],
+        completed: List[Tuple[str, List[float]]],
         beam_width: int,
-    ) -> Tuple[List[Beam], List[Beam]]:
+    ):
         """Egalitarian ranking; EOS tokens complete; dedup; keep top beams
-        (reference :557-602)."""
-        new_beams: List[Beam] = []
+        (reference :557-602).  Survivors keep (candidate, parent slot) so the
+        session can advance them."""
+        new_beams = []
         seen = set()
-        for sequence, rewards, token in sorted(
+        for sequence, rewards, cand, parent in sorted(
             candidates, key=lambda c: min(c[1]), reverse=True
         ):
             if sequence in seen:
                 continue
-            if token in EOS_TOKENS:
+            if cand.token in EOS_TOKENS:
                 completed.append((sequence, rewards))
             elif len(new_beams) < beam_width:
-                new_beams.append((sequence, rewards))
+                new_beams.append((sequence, rewards, cand, parent))
                 seen.add(sequence)
         return new_beams, completed
 
     @staticmethod
-    def _select_best(completed: List[Beam]) -> str:
+    def _select_best(completed: List[Tuple[str, List[float]]]) -> str:
         filtered = [
             (seq, rewards)
             for seq, rewards in completed

@@ -243,12 +243,12 @@ def forward(
             keys = jax.lax.dynamic_update_slice(k_cache_l, k, (0, write_index, 0, 0))
             values = jax.lax.dynamic_update_slice(v_cache_l, v, (0, write_index, 0, 0))
 
-        # GQA: repeat kv heads up to n_heads.
         reps = h // kv
-        keys_r = jnp.repeat(keys, reps, axis=2)  # (B, T, H, hd)
-        values_r = jnp.repeat(values, reps, axis=2)
 
         if c.use_flash_attention and cache is None:
+            # The pallas kernel takes equal q/kv head counts; expand here.
+            keys_r = jnp.repeat(keys, reps, axis=2)  # (B, T, H, hd)
+            values_r = jnp.repeat(values, reps, axis=2)
             # Pallas blockwise kernel: no (B, H, S, S) logits in HBM.  The
             # kernel's masking model is one contiguous valid span per row,
             # described by (start, length) scalars — start=0 covers the
@@ -285,13 +285,18 @@ def forward(
                 )
             attn = attn.astype(x.dtype)
         else:
-            logits = jnp.einsum("bshd,bthd->bhst", q, keys_r).astype(jnp.float32)
+            # GQA without materializing repeated KV: group q heads by their
+            # kv head — on the decode path jnp.repeat would re-write the
+            # whole (B, T, H, hd) cache expansion every layer every step,
+            # doubling HBM traffic for nothing.
+            qg = q.reshape(batch, span, kv, reps, hd)
+            logits = jnp.einsum("bsgrd,btgd->bgrst", qg, keys).astype(jnp.float32)
             logits = logits * c.q_scale
             logits = _softcap(logits, c.attn_softcap)
             mask = jnp.where(is_local, local_mask, global_mask)
-            logits = jnp.where(mask, logits, MASK_FILL)
+            logits = jnp.where(mask[:, :, None], logits, MASK_FILL)
             weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-            attn = jnp.einsum("bhst,bthd->bshd", weights, values_r)
+            attn = jnp.einsum("bgrst,btgd->bsgrd", weights, values)
         attn = attn.reshape(batch, span, h * hd) @ lp["wo"]
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)

@@ -1,0 +1,139 @@
+"""Token-search sessions: TPU incremental KV-cache path vs full-prefix oracle.
+
+The TPU session (backends/tpu.py:TPUTokenSearchSession) must produce the
+same proposals and agent scores as the cacheless fallback
+(backends/session.py:PrefixTokenSearchSession), which re-runs full prefixes
+through the same backend.  With the byte tokenizer, decode+re-encode is
+exact, so the two paths see identical token sequences and should agree to
+float tolerance.
+"""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.session import (
+    PrefixTokenSearchSession,
+    SearchSpec,
+    open_token_search,
+)
+from consensus_tpu.backends.tpu import TPUBackend, TPUTokenSearchSession
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return TPUBackend(model="tiny-gemma2", dtype="float32", max_context=256)
+
+
+def make_spec(**kw):
+    defaults = dict(
+        ref_system="You draft consensus statements.",
+        ref_user="Issue: taxes.\nOpinions: A wants more, B wants less.\nStatement:",
+        agent_prompts=(
+            ("Agent context.", "Opinion: A wants more.\nStatement:"),
+            ("Agent context.", "Opinion: B wants less.\nStatement:"),
+        ),
+        n_slots=2,
+        k=3,
+        temperature=1.0,
+        seed=11,
+        sample=False,  # deterministic top-k: both paths must pick the same ids
+        max_steps=8,
+    )
+    defaults.update(kw)
+    return SearchSpec(**defaults)
+
+
+def test_factory_prefers_tpu_session(backend):
+    session = open_token_search(backend, make_spec())
+    assert isinstance(session, TPUTokenSearchSession)
+
+
+def test_factory_falls_back_over_cache_cap(backend):
+    session = open_token_search(backend, make_spec(n_slots=100_000))
+    assert isinstance(session, PrefixTokenSearchSession)
+
+
+def test_incremental_matches_full_prefix(backend):
+    spec = make_spec()
+    tpu = TPUTokenSearchSession(backend, spec)
+    oracle = PrefixTokenSearchSession(backend, spec)
+
+    tpu_props = tpu.propose()
+    oracle_props = oracle.propose()
+    for step in range(3):
+        assert len(tpu_props) == spec.n_slots
+        for slot in range(spec.n_slots):
+            t_ids = [c.token_id for c in tpu_props[slot]]
+            o_ids = [c.token_id for c in oracle_props[slot]]
+            assert t_ids == o_ids, f"step {step} slot {slot}"
+            np.testing.assert_allclose(
+                [c.ref_logprob for c in tpu_props[slot]],
+                [c.ref_logprob for c in oracle_props[slot]],
+                atol=5e-4,
+            )
+            for t_cand, o_cand in zip(tpu_props[slot], oracle_props[slot]):
+                # Agent-score parity holds only for tokens whose string
+                # round-trips to the same single id: the fallback scores the
+                # re-encoded *string* (all an API backend can do), so special
+                # tokens like <eos> re-encode as literal characters there
+                # while the TPU path scores the true id.
+                if backend.tokenizer.encode(t_cand.token) != [t_cand.token_id]:
+                    continue
+                np.testing.assert_allclose(
+                    t_cand.agent_logprobs, o_cand.agent_logprobs, atol=5e-4
+                )
+        # Advance: slot 0 takes its best candidate, slot 1 branches from
+        # slot 0's second-best (exercises the cross-slot cache gather).
+        # Both must round-trip id -> string -> id, or the oracle's string
+        # state diverges from the TPU session's id state by construction.
+        roundtrip = [
+            c for c in tpu_props[0]
+            if backend.tokenizer.encode(c.token) == [c.token_id]
+        ]
+        assert len(roundtrip) >= 2, "test model proposed only special tokens"
+        parents = [0, 0]
+        chosen = [roundtrip[0], roundtrip[1]]
+        tpu_props = tpu.advance_and_propose(parents, chosen)
+        oracle_props = oracle.advance_and_propose(parents, chosen)
+
+
+def test_gumbel_proposals_are_seed_deterministic(backend):
+    spec = make_spec(sample=True, seed=5)
+    a = TPUTokenSearchSession(backend, spec).propose()
+    b = TPUTokenSearchSession(backend, spec).propose()
+    assert [c.token_id for c in a[0]] == [c.token_id for c in b[0]]
+    different = TPUTokenSearchSession(backend, make_spec(sample=True, seed=6)).propose()
+    assert [c.token_id for c in a[0]] != [c.token_id for c in different[0]]
+
+
+def test_session_exhaustion_raises(backend):
+    spec = make_spec(max_steps=1)
+    session = TPUTokenSearchSession(backend, spec)
+    props = session.propose()
+    parents = [0, 1]
+    chosen = [props[0][0], props[1][0]]
+    props = session.advance_and_propose(parents, chosen)
+    with pytest.raises(ValueError):
+        session.advance_and_propose(parents, [props[0][0], props[1][0]])
+
+
+def test_beam_search_runs_on_tpu_session(backend):
+    from consensus_tpu.methods import get_method_generator
+
+    issue = "Should the town build a new library?"
+    opinions = {
+        "Agent 1": "Yes, libraries anchor the community.",
+        "Agent 2": "Only if it does not raise taxes.",
+    }
+    gen = get_method_generator(
+        "beam_search", backend,
+        {"beam_width": 2, "max_tokens": 6, "seed": 3},
+    )
+    statement = gen.generate_statement(issue, opinions)
+    assert isinstance(statement, str)
+    # Determinism: a fresh run with the same seed reproduces the statement.
+    gen2 = get_method_generator(
+        "beam_search", backend,
+        {"beam_width": 2, "max_tokens": 6, "seed": 3},
+    )
+    assert gen2.generate_statement(issue, opinions) == statement

@@ -47,11 +47,16 @@ class TestBeamSearch:
         assert s1 == s2
 
     def test_prune_moves_eos_to_completed(self):
+        from consensus_tpu.backends.session import ScoredCandidate
+
+        def cand(token):
+            return ScoredCandidate(token, 7, -1.0, (-1.0, -1.0))
+
         eos = next(iter(EOS_TOKENS))
         candidates = [
-            ("good seq one two three four five", [2.0, 1.0], "tok"),
-            ("done seq" + eos, [0.5, 0.4], eos),
-            ("bad seq", [-5.0, -9.0], "tok"),
+            ("good seq one two three four five", [2.0, 1.0], cand("tok"), 0),
+            ("done seq" + eos, [0.5, 0.4], cand(eos), 0),
+            ("bad seq", [-5.0, -9.0], cand("tok"), 1),
         ]
         beams, completed = BeamSearchGenerator._prune(candidates, [], beam_width=1)
         assert len(beams) == 1 and beams[0][0].startswith("good")
