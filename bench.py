@@ -37,6 +37,7 @@ NEW_TOKENS = 50
 BON_ROUNDS = 3
 BASELINE_BON_STATEMENTS_PER_SEC = 1.0 / 70.0
 BASELINE_BEAM_STATEMENTS_PER_SEC = 1.0 / 4019.0
+BASELINE_LOOKAHEAD_STATEMENTS_PER_SEC = 1.0 / 944.0
 
 ISSUE = "Should we increase taxes to fund a more comprehensive benefits system?"
 # Paper scenario 2 (5 agents) — consensus_tpu/data/aamas_scenarios.py.
@@ -86,11 +87,29 @@ def main() -> None:
         )
         return generator.generate_statement(issue, opinions)
 
+    one_beam(11)  # warmup / compile
     start = time.perf_counter()
-    beam_statement = one_beam(11)
+    beam_statement = one_beam(12)
     beam_elapsed = time.perf_counter() - start
     assert isinstance(beam_statement, str)
     beam_sps = 1.0 / beam_elapsed
+
+    # ---- finite lookahead (bf=3, depth=3: the paper's deepest grid) --
+    def one_lookahead(seed: int) -> str:
+        generator = get_method_generator(
+            "finite_lookahead",
+            backend,
+            {"branching_factor": 3, "max_depth": 3,
+             "max_tokens": NEW_TOKENS, "seed": seed},
+        )
+        return generator.generate_statement(issue, opinions)
+
+    one_lookahead(21)  # warmup / compile
+    start = time.perf_counter()
+    lookahead_statement = one_lookahead(22)
+    lookahead_elapsed = time.perf_counter() - start
+    assert isinstance(lookahead_statement, str)
+    lookahead_sps = 1.0 / lookahead_elapsed
 
     print(
         json.dumps(
@@ -106,6 +125,12 @@ def main() -> None:
                         beam_sps / BASELINE_BEAM_STATEMENTS_PER_SEC, 2
                     ),
                     "beam_search_seconds_per_statement": round(beam_elapsed, 2),
+                    "finite_lookahead_seconds_per_statement": round(
+                        lookahead_elapsed, 2
+                    ),
+                    "finite_lookahead_vs_baseline": round(
+                        lookahead_sps / BASELINE_LOOKAHEAD_STATEMENTS_PER_SEC, 2
+                    ),
                     "bon_seconds_per_statement": round(bon_elapsed / BON_ROUNDS, 2),
                     "weights": "random",
                 },

@@ -94,41 +94,80 @@ class PrefixTokenSearchSession:
         self._step += 1
         return self._propose_and_score()
 
+    def propose_suffixes(
+        self, suffixes: Sequence[Sequence[ScoredCandidate]], salt: int
+    ) -> List[List[ScoredCandidate]]:
+        """Propose + score k candidates for each tree path hanging off the
+        trunk (slot 0's sequence).  Full-prefix fallback: one batched
+        next-token call over all paths plus one batched score call over
+        (path x candidate x agent)."""
+        spec = self.spec
+        if spec.n_slots != 1:
+            raise ValueError("propose_suffixes requires an n_slots=1 session")
+        if not suffixes:
+            return []
+        trunk = self._sequences[0]
+        prefixes = [
+            trunk + "".join(c.token for c in suffix) for suffix in suffixes
+        ]
+        return self._proposals_for(prefixes, family=1, index=salt)
+
     # -- internals -----------------------------------------------------------
 
-    def _propose_and_score(self) -> List[List[ScoredCandidate]]:
+    def _proposals_for(
+        self, prefixes: Sequence[str], family: int, index: int
+    ) -> List[List[ScoredCandidate]]:
+        """One batched next-token call over ``prefixes`` + one batched score
+        call over (prefix x candidate x agent).  ``(family, index, row)``
+        triples map injectively onto request seeds, so no two requests in a
+        session ever share one."""
         spec = self.spec
         seed = spec.seed
         requests = [
             NextTokenRequest(
-                user_prompt=spec.ref_user + sequence,
+                user_prompt=spec.ref_user + prefix,
                 system_prompt=spec.ref_system,
                 k=spec.k,
                 temperature=spec.temperature,
-                seed=((seed + self._step) * 1000 + slot) if seed is not None else None,
+                seed=(
+                    (seed * 2 + family) * 1_000_000 + index * 1000 + row
+                )
+                if seed is not None
+                else None,
                 mode="sample" if spec.sample else "topk",
                 bias_against_tokens=spec.bias_against_tokens,
                 bias_value=spec.bias_value,
                 chat=False,
             )
-            for slot, sequence in enumerate(self._sequences)
+            for row, prefix in enumerate(prefixes)
         ]
         proposals = self.backend.next_token_logprobs(requests)
 
         score_requests = []
-        for sequence, candidates in zip(self._sequences, proposals):
+        for prefix, candidates in zip(prefixes, proposals):
             for candidate in candidates:
                 for a_system, a_user in spec.agent_prompts:
                     score_requests.append(
                         ScoreRequest(
-                            context=a_user + sequence,
+                            context=a_user + prefix,
                             continuation=candidate.token,
                             system_prompt=a_system,
                             chat=False,
                         )
                     )
         scores = self.backend.score(score_requests)
+        return self._zip_scores(proposals, scores)
 
+    def _propose_and_score(self) -> List[List[ScoredCandidate]]:
+        # Seed family 0: trunk/beam steps (family 1 = suffix trees) — the
+        # families must stay disjoint or a suffix level whose salt equals a
+        # later trunk step would replay its exact proposal requests.
+        return self._proposals_for(
+            self._sequences, family=0, index=self._step
+        )
+
+    def _zip_scores(self, proposals, scores) -> List[List[ScoredCandidate]]:
+        spec = self.spec
         n_agents = len(spec.agent_prompts)
         out: List[List[ScoredCandidate]] = []
         flat = 0

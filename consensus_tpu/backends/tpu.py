@@ -579,6 +579,13 @@ class TPUTokenSearchSession:
         ]
         token_lists = [tok.encode(p, add_bos=True) for p in prefixes]
         max_prefix = backend.max_context - spec.max_steps
+        if max_prefix < 16:
+            # A negative/zero budget would flip the slice below into keeping
+            # the WRONG end (and silently lose the generation-slot reserve).
+            raise ValueError(
+                f"max_steps={spec.max_steps} leaves no prefix room inside "
+                f"max_context={backend.max_context}"
+            )
         token_lists = [ids[-max_prefix:] for ids in token_lists]
         self._tokens, self._valid = backend._left_pad_batch(token_lists)
         self._w0 = int(self._tokens.shape[1])
@@ -653,29 +660,70 @@ class TPUTokenSearchSession:
         )
         return self._finish(out)
 
+    def propose_suffixes(
+        self, suffixes: Sequence[Sequence], salt: int
+    ) -> List[List["ScoredCandidate"]]:
+        """Propose + score k candidates for each tree path (a suffix of
+        candidates hanging off the trunk), sharing the trunk cache across
+        all paths (models/stepper.py:suffix_propose).  Trunk sessions only
+        (n_slots == 1); the trunk itself advances via advance_and_propose."""
+        from consensus_tpu.models.stepper import suffix_propose
+
+        spec = self.spec
+        if spec.n_slots != 1:
+            raise ValueError("propose_suffixes requires an n_slots=1 session")
+        if self._cache is None:
+            raise ValueError("call propose() before propose_suffixes()")
+        if not suffixes:
+            return []
+        span = len(suffixes[0])
+        if any(len(s) != span for s in suffixes) or span == 0:
+            raise ValueError("suffixes must share one non-zero length")
+        # Pad the path count to a bucket (repeating row 0) so XLA reuses a
+        # small set of compiled (P, L) shapes across tree levels.
+        n_paths = _bucket(len(suffixes), minimum=4)
+        tokens = np.zeros((n_paths, span), np.int32)
+        for i, suffix in enumerate(suffixes):
+            tokens[i] = [c.token_id for c in suffix]
+        tokens[len(suffixes):] = tokens[0]
+
+        packed = np.asarray(
+            suffix_propose(
+                self.backend.params, self.backend.config,
+                self._cache, self._cur_pos,
+                jnp.asarray(tokens), jnp.asarray(salt, jnp.int32),
+                self.n_roles, self._base_key, self._temperature,
+                spec.k, spec.sample,
+                ref_bias=self._ref_bias,
+            )
+        )[: len(suffixes)]
+        return self._unpack(packed)
+
     # -- internals -----------------------------------------------------------
 
     def _finish(self, out) -> List[List["ScoredCandidate"]]:
-        from consensus_tpu.backends.session import ScoredCandidate
-
         self._cache = out.cache
         self._cur_pos = out.cur_pos
-        packed = np.asarray(out.packed)  # (B, k, 2 + A)
+        return self._unpack(np.asarray(out.packed))
+
+    def _unpack(self, packed: np.ndarray) -> List[List["ScoredCandidate"]]:
+        from consensus_tpu.backends.session import ScoredCandidate
+
         tok = self.backend.tokenizer
         results = []
-        for slot in range(self.spec.n_slots):
-            slot_out = []
+        for row in range(packed.shape[0]):
+            row_out = []
             for j in range(self.spec.k):
-                token_id = int(packed[slot, j, 0])
-                slot_out.append(
+                token_id = int(packed[row, j, 0])
+                row_out.append(
                     ScoredCandidate(
                         token=tok.token_str(token_id),
                         token_id=token_id,
-                        ref_logprob=float(packed[slot, j, 1]),
+                        ref_logprob=float(packed[row, j, 1]),
                         agent_logprobs=tuple(
-                            float(v) for v in packed[slot, j, 2:]
+                            float(v) for v in packed[row, j, 2:]
                         ),
                     )
                 )
-            results.append(slot_out)
+            results.append(row_out)
         return results

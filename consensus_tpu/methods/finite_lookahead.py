@@ -1,4 +1,4 @@
-"""Finite-lookahead (receding-horizon) token decoder, batched per tree level.
+"""Finite-lookahead (receding-horizon) token decoder over a trunk session.
 
 Reference: ``src/methods/finite_lookahead.py`` (536 LoC; SURVEY §2.5).
 Semantics preserved:
@@ -14,23 +14,32 @@ Semantics preserved:
   documented reference-policy/KL subtraction is commented out there, and the
   selection is max-min, not the Nash welfare its docstring claims;
   SURVEY §7.4 says replicate the actual semantics, so: plain mean logprob,
-  egalitarian argmax);
+  egalitarian argmax).  By the chain rule the path mean equals the mean of
+  the per-token logprobs collected as the tree grows, which is how the
+  session delivers them — token t's agent score comes out of the same
+  forward that proposed it;
 * only the best path's FIRST token is appended (:530-536); emission stops
   when that token is a terminator.
 
 Cost redesign: the reference walks the tree with one 1-token API call per
 node and one scoring call per (path, agent) — 944–2 096 s per statement
-measured (SURVEY §6).  Here each tree LEVEL is one batched
-``next_token_logprobs`` call (every frontier node expanded at once, exact
-k-distinct sampling) and all (path × agent) scores are one batched ``score``
-call.
+measured (SURVEY §6).  Here the whole statement runs through ONE trunk
+session (backends/session.py): on the TPU backend the trunk (prompt +
+statement so far) lives in an (agents+1)-row KV cache, each tree LEVEL is
+one fused device call whose path suffixes broadcast-attend the SHARED trunk
+cache (models/transformer.py:forward_shared_trunk — zero cache
+duplication), and advancing the trunk by the chosen token is one more call.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
-from consensus_tpu.backends.base import NextTokenRequest, ScoreRequest
+from consensus_tpu.backends.session import (
+    ScoredCandidate,
+    SearchSpec,
+    open_token_search,
+)
 from consensus_tpu.methods.base import BaseGenerator
 from consensus_tpu.methods.beam_search import BIAS_AGAINST_TOKENS
 from consensus_tpu.methods.brushup import brushup_statement_ending
@@ -44,6 +53,9 @@ TERMINATOR_TOKENS = frozenset(
 )
 
 DEFAULT_FAILURE_REWARD = -10.0
+
+#: A tree path: its candidates in order + running per-agent logprob sums.
+Path = Tuple[List[ScoredCandidate], List[float]]
 
 
 class FiniteLookaheadGenerator(BaseGenerator):
@@ -59,21 +71,45 @@ class FiniteLookaheadGenerator(BaseGenerator):
         if not agents:
             return ""
 
+        system, user = reference_prompt(
+            issue, agent_opinions, variant="finite_lookahead"
+        )
+        agent_prompts = tuple(
+            agent_prompt(issue, opinion, variant="finite_lookahead")
+            for _, opinion in agents
+        )
+        session = open_token_search(
+            self.backend,
+            SearchSpec(
+                ref_system=system,
+                ref_user=user,
+                agent_prompts=agent_prompts,
+                n_slots=1,  # trunk session: the tree shares the trunk cache
+                k=branching,
+                temperature=temperature,
+                seed=seed,
+                sample=True,
+                bias_against_tokens=BIAS_AGAINST_TOKENS,
+                max_steps=max_tokens,
+                failure_logprob=DEFAULT_FAILURE_REWARD,
+            ),
+        )
+
         statement = ""
+        root_proposals = session.propose()[0]
         for step in range(max_tokens):
-            paths = self._tree_paths(
-                issue, agent_opinions, statement, branching, max_depth,
-                temperature,
-                seed=(seed + step) if seed is not None else None,
+            best = self._best_path(
+                session, root_proposals, branching, max_depth, step
             )
-            if not paths:
+            if best is None:
                 break
-            first_token = self._best_first_token(issue, agents, statement, paths)
-            if first_token is None:
+            first = best[0][0]
+            if first.token in TERMINATOR_TOKENS:
                 break
-            if first_token in TERMINATOR_TOKENS:
+            statement += first.token
+            if step == max_tokens - 1:
                 break
-            statement += first_token
+            root_proposals = session.advance_and_propose([0], [first])[0]
 
         statement = statement.strip()
         self.pre_brushup_statement = statement
@@ -83,90 +119,51 @@ class FiniteLookaheadGenerator(BaseGenerator):
 
     # -- tree ----------------------------------------------------------------
 
-    def _tree_paths(
-        self,
-        issue: str,
-        agent_opinions: Dict[str, str],
-        statement: str,
-        branching: int,
-        max_depth: int,
-        temperature: float,
-        seed,
-    ) -> List[List[str]]:
-        """Grow the lookahead tree level by level — one batched call per
-        level over the whole frontier — and return deduplicated token paths."""
-        system, user = reference_prompt(issue, agent_opinions, variant="finite_lookahead")
-        frontier: List[List[str]] = [[]]  # token paths still growing
-        finished: List[List[str]] = []
+    @staticmethod
+    def _best_path(
+        session, root_proposals: List[ScoredCandidate], branching: int,
+        max_depth: int, step: int,
+    ):
+        """Grow the level-batched tree from the trunk, accumulate per-agent
+        logprob sums along every path, and return the max-min mean path
+        (reference :424-536)."""
+        frontier: List[Path] = []
+        finished: List[Path] = []
+        for cand in root_proposals[:branching]:
+            node: Path = ([cand], list(cand.agent_logprobs))
+            if cand.token in TERMINATOR_TOKENS:
+                finished.append(node)
+            else:
+                frontier.append(node)
 
-        for depth in range(max_depth):
+        for depth in range(1, max_depth):
             if not frontier:
                 break
-            requests = [
-                NextTokenRequest(
-                    user_prompt=user + statement + "".join(path),
-                    system_prompt=system,
-                    k=branching,
-                    temperature=temperature,
-                    seed=(seed * 1000 + depth * 100 + i)
-                    if seed is not None
-                    else None,
-                    mode="sample",
-                    bias_against_tokens=BIAS_AGAINST_TOKENS,
-                    chat=False,
-                )
-                for i, path in enumerate(frontier)
-            ]
-            proposals = self.backend.next_token_logprobs(requests)
-            next_frontier: List[List[str]] = []
-            for path, candidates in zip(frontier, proposals):
-                for candidate in candidates:
-                    extended = path + [candidate.token]
-                    if candidate.token in TERMINATOR_TOKENS:
-                        finished.append(extended)
+            proposals = session.propose_suffixes(
+                [path for path, _ in frontier], salt=step * max_depth + depth
+            )
+            next_frontier: List[Path] = []
+            for (path, sums), candidates in zip(frontier, proposals):
+                for cand in candidates:
+                    node = (
+                        path + [cand],
+                        [s + lp for s, lp in zip(sums, cand.agent_logprobs)],
+                    )
+                    if cand.token in TERMINATOR_TOKENS:
+                        finished.append(node)
                     else:
-                        next_frontier.append(extended)
+                        next_frontier.append(node)
             frontier = next_frontier
 
-        all_paths = finished + frontier
-        deduped: List[List[str]] = []
+        # Dedup by joined token string, drop empties (reference :402-414).
+        best, best_welfare = None, None
         seen = set()
-        for path in all_paths:
-            key = "".join(path)
-            if key and key not in seen:
-                seen.add(key)
-                deduped.append(path)
-        return deduped
-
-    def _best_first_token(
-        self,
-        issue: str,
-        agents: List[Tuple[str, str]],
-        statement: str,
-        paths: List[List[str]],
-    ):
-        """Score all (path × agent) pairs in one batched call; return the
-        first token of the max-min path (reference :424-536)."""
-        requests = []
-        for path in paths:
-            for _, opinion in agents:
-                a_system, a_user = agent_prompt(issue, opinion, variant="finite_lookahead")
-                requests.append(
-                    ScoreRequest(
-                        context=a_user + statement,
-                        continuation="".join(path),
-                        system_prompt=a_system,
-                        chat=False,
-                    )
-                )
-        results = self.backend.score(requests)
-
-        n_agents = len(agents)
-        best_path, best_welfare = None, None
-        for i, path in enumerate(paths):
-            scores = results[i * n_agents : (i + 1) * n_agents]
-            utilities = [s.mean(default=DEFAULT_FAILURE_REWARD) for s in scores]
-            welfare = min(utilities)
+        for path, sums in finished + frontier:
+            key = "".join(c.token for c in path)
+            if not key or key in seen:
+                continue
+            seen.add(key)
+            welfare = min(s / len(path) for s in sums)
             if best_welfare is None or welfare > best_welfare:
-                best_welfare, best_path = welfare, path
-        return best_path[0] if best_path else None
+                best_welfare, best = welfare, (path, sums)
+        return best

@@ -41,6 +41,7 @@ from consensus_tpu.models.generate import left_pad_positions
 from consensus_tpu.models.transformer import (
     KVCache,
     forward,
+    forward_shared_trunk,
     make_cache,
     project_logits,
 )
@@ -58,12 +59,15 @@ def _propose_and_score(
     hidden_last: jax.Array,  # (R, D) final-norm hidden of the last position
     n_beams: int,
     n_roles: int,
-    base_key: jax.Array,  # (2,) — per-(step, slot) keys fold in-device
+    base_key: jax.Array,  # (2,) — per-(family, step, slot) keys fold in-device
     step_index: jax.Array,  # () int32
     temperature: jax.Array,  # () f32
     k: int,
     sample: bool,
     ref_bias: Optional[jax.Array],  # (V,) additive bias for ref rows only
+    key_family: int = 0,  # disjoint PRNG stream per call family (trunk=0,
+    # suffix-tree=1): nested folds keep streams collision-free even when a
+    # trunk step index equals a suffix salt.
 ) -> jax.Array:
     logits = project_logits(params, config, hidden_last)  # (R, V) f32
     per_beam = logits.reshape(n_beams, n_roles, -1)
@@ -77,10 +81,11 @@ def _propose_and_score(
     # sample=False is deterministic top-k.
     scores = ref_lp / jnp.maximum(temperature, 1e-6)
     if sample:
+        step_key = jax.random.fold_in(
+            jax.random.fold_in(base_key, key_family), step_index
+        )
         slot_keys = jax.vmap(
-            lambda slot: jax.random.fold_in(
-                base_key, step_index * n_beams + slot
-            )
+            lambda slot: jax.random.fold_in(step_key, slot)
         )(jnp.arange(n_beams))
         gumbel = jax.vmap(lambda kk: jax.random.gumbel(kk, ref_lp.shape[-1:]))(
             slot_keys
@@ -205,3 +210,33 @@ def search_step(
         step_index, temperature, k, sample, ref_bias,
     )
     return StepOutput(packed, cache, cur_pos)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "n_roles", "k", "sample")
+)
+def suffix_propose(
+    params,
+    config: ModelConfig,
+    cache: KVCache,  # trunk cache, n_roles rows (NOT consumed)
+    cur_pos: jax.Array,  # (n_roles,) int32
+    suffix_tokens: jax.Array,  # (P, L) int32 — one row per frontier path
+    salt: jax.Array,  # () int32 — folds into per-path proposal keys
+    n_roles: int,
+    base_key: jax.Array,  # (2,)
+    temperature: jax.Array,
+    k: int,
+    sample: bool,
+    ref_bias: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Propose + score k next tokens for every tree path over the SHARED
+    trunk cache (models/transformer.py:forward_shared_trunk).  Returns the
+    packed (P, k, 2 + A) candidate array; the trunk cache is untouched, so
+    a lookahead tree costs one call per LEVEL and zero cache duplication."""
+    n_paths = suffix_tokens.shape[0]
+    hidden = forward_shared_trunk(params, config, suffix_tokens, cache, cur_pos)
+    return _propose_and_score(
+        params, config, hidden.reshape(n_paths * n_roles, -1),
+        n_paths, n_roles, base_key, salt, temperature, k, sample, ref_bias,
+        key_family=1,
+    )

@@ -344,6 +344,122 @@ def forward(
     return project_logits(params, c, x), new_cache
 
 
+def forward_shared_trunk(
+    params: Params,
+    config: ModelConfig,
+    suffix_tokens: jax.Array,  # (P, L) int32 — per-path suffix token ids
+    cache: KVCache,  # R-row trunk cache (one row per role), read-only
+    cur_pos: jax.Array,  # (R,) int32 — last written trunk position per role
+) -> jax.Array:
+    """Forward P path suffixes over ONE shared R-row trunk cache.
+
+    Every lookahead-tree path shares the trunk (prompt + statement so far);
+    only its <=`L`-token suffix differs.  Materializing the trunk cache per
+    (path x role) row would cost P x the HBM of the trunk — instead the
+    trunk keys/values keep their (R, T, ...) shape and broadcast against
+    (P, R, ...) suffix queries inside the attention einsums, so the only
+    per-path state is the L-token suffix itself.  The cache is not written.
+
+    Returns final-norm hidden states of the LAST suffix position, (P, R, D).
+    Replaces the per-node API walk of the reference's `_generate_tree_paths`
+    (finite_lookahead.py:225-422) at zero cache duplication.
+    """
+    c = config
+    n_paths, span = suffix_tokens.shape
+    h, kv, hd = c.n_heads, c.n_kv_heads, c.head_dim
+    reps = h // kv
+    n_roles = cache.key_valid.shape[0]
+
+    x = params["embed"][suffix_tokens]  # (P, L, D)
+    if c.scale_embeddings:
+        x = x * jnp.asarray(c.d_model**0.5, x.dtype)
+    x = jnp.broadcast_to(x[:, None], (n_paths, n_roles) + x.shape[1:])  # (P,R,L,D)
+
+    # Suffix positions continue each role's trunk: (R, L).
+    positions = cur_pos[:, None] + 1 + jnp.arange(span)[None, :]
+
+    # Masks are path-independent. Trunk: every suffix position sees every
+    # valid trunk key (trunk positions always precede the suffix), windowed
+    # for local layers. Suffix: causal within the path, same window.
+    qp = positions[:, :, None]  # (R, L, 1)
+    trunk_kp = cache.key_positions[:, None, :]  # (R, 1, T)
+    trunk_mask = cache.key_valid[:, None, :] & jnp.ones(
+        (1, span, 1), bool
+    )  # (R, L, T)
+    suffix_causal = (
+        jnp.arange(span)[:, None] >= jnp.arange(span)[None, :]
+    )  # (L, L)
+    if c.sliding_window is not None:
+        trunk_local = trunk_mask & (qp - trunk_kp < c.sliding_window)
+        suffix_kp = positions[:, None, :]  # (R, 1, L)
+        suffix_local = suffix_causal[None] & (qp - suffix_kp < c.sliding_window)
+    else:
+        trunk_local = trunk_mask
+        suffix_local = jnp.broadcast_to(
+            suffix_causal[None], (n_roles, span, span)
+        )
+    local_flags = jnp.asarray(c.local_flags)
+
+    def layer_step(x, scanned):
+        lp, k_trunk, v_trunk, is_local = scanned  # k/v_trunk: (R, T, kv, hd)
+
+        attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
+        flat = attn_in.reshape(n_paths * n_roles, span, -1)
+        q = (flat @ lp["wq"]).reshape(n_paths * n_roles, span, h, hd)
+        ks = (flat @ lp["wk"]).reshape(n_paths * n_roles, span, kv, hd)
+        vs = (flat @ lp["wv"]).reshape(n_paths * n_roles, span, kv, hd)
+        rope_pos = jnp.tile(positions, (n_paths, 1))  # (P*R, L)
+        q = apply_rope(q, rope_pos, c.rope_theta)
+        ks = apply_rope(ks, rope_pos, c.rope_theta)
+        qg = q.reshape(n_paths, n_roles, span, kv, reps, hd)
+        ks = ks.reshape(n_paths, n_roles, span, kv, hd)
+        vs = vs.reshape(n_paths, n_roles, span, kv, hd)
+
+        # Trunk attention broadcasts the shared (R, T) keys over paths.
+        lt = jnp.einsum("prsgmd,rtgd->prgmst", qg, k_trunk).astype(jnp.float32)
+        ls = jnp.einsum("prsgmd,prtgd->prgmst", qg, ks).astype(jnp.float32)
+        logits = jnp.concatenate([lt, ls], axis=-1) * c.q_scale
+        logits = _softcap(logits, c.attn_softcap)
+        t_mask = jnp.where(is_local, trunk_local, trunk_mask)
+        s_mask = jnp.where(
+            is_local, suffix_local, jnp.broadcast_to(
+                suffix_causal[None], suffix_local.shape
+            )
+        )
+        mask = jnp.concatenate(
+            [t_mask, s_mask], axis=-1
+        )[None, :, None, None]  # (1, R, 1, 1, L, T+L)
+        logits = jnp.where(mask, logits, MASK_FILL)
+        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        t_len = k_trunk.shape[1]
+        attn = jnp.einsum(
+            "prgmst,rtgd->prsgmd", weights[..., :t_len], v_trunk
+        ) + jnp.einsum(
+            "prgmst,prtgd->prsgmd", weights[..., t_len:], vs
+        )
+        attn = attn.reshape(n_paths, n_roles, span, h * hd) @ lp["wo"]
+        if c.use_post_norms:
+            attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
+        x = x + attn
+
+        ffn_in = rms_norm(x, lp["ffn_norm"], c.rms_eps, c.rmsnorm_style)
+        gate = ffn_in @ lp["w_gate"]
+        if c.activation == "geglu":
+            gate = jax.nn.gelu(gate, approximate=True)
+        else:
+            gate = jax.nn.silu(gate)
+        ffn = (gate * (ffn_in @ lp["w_up"])) @ lp["w_down"]
+        if c.use_post_norms:
+            ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
+        return x + ffn, None
+
+    x, _ = jax.lax.scan(
+        layer_step, x, (params["layers"], cache.k, cache.v, local_flags)
+    )
+    x = rms_norm(x, params["final_norm"], c.rms_eps, c.rmsnorm_style)
+    return x[:, :, -1, :]  # (P, R, D)
+
+
 # ---------------------------------------------------------------------------
 # Teacher-forced scoring
 # ---------------------------------------------------------------------------

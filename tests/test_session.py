@@ -117,6 +117,81 @@ def test_session_exhaustion_raises(backend):
         session.advance_and_propose(parents, [props[0][0], props[1][0]])
 
 
+def test_suffix_propose_matches_full_prefix(backend):
+    """Trunk-shared tree expansion == fallback full-prefix expansion."""
+    spec = make_spec(n_slots=1, sample=False, k=2)
+    tpu = TPUTokenSearchSession(backend, spec)
+    oracle = PrefixTokenSearchSession(backend, spec)
+
+    t_root = tpu.propose()[0]
+    o_root = oracle.propose()[0]
+    assert [c.token_id for c in t_root] == [c.token_id for c in o_root]
+
+    roundtrip = [
+        c for c in t_root
+        if backend.tokenizer.encode(c.token) == [c.token_id]
+    ]
+    assert roundtrip, "test model proposed only special tokens"
+    # Two level-1 paths off the same trunk (duplicated to test row padding).
+    suffixes = [[roundtrip[0]], [roundtrip[0]]]
+    t_props = tpu.propose_suffixes(suffixes, salt=3)
+    o_props = oracle.propose_suffixes(suffixes, salt=3)
+    assert len(t_props) == len(o_props) == 2
+    for t_slot, o_slot in zip(t_props, o_props):
+        assert [c.token_id for c in t_slot] == [c.token_id for c in o_slot]
+        np.testing.assert_allclose(
+            [c.ref_logprob for c in t_slot],
+            [c.ref_logprob for c in o_slot],
+            atol=5e-4,
+        )
+        for t_cand, o_cand in zip(t_slot, o_slot):
+            if backend.tokenizer.encode(t_cand.token) != [t_cand.token_id]:
+                continue
+            np.testing.assert_allclose(
+                t_cand.agent_logprobs, o_cand.agent_logprobs, atol=5e-4
+            )
+    # Depth-2 suffixes exercise the in-suffix causal attention.
+    deeper = [
+        [roundtrip[0], c] for c in t_props[0]
+        if backend.tokenizer.encode(c.token) == [c.token_id]
+    ]
+    if deeper:
+        t2 = tpu.propose_suffixes(deeper, salt=4)
+        o2 = oracle.propose_suffixes(deeper, salt=4)
+        for t_slot, o_slot in zip(t2, o2):
+            assert [c.token_id for c in t_slot] == [c.token_id for c in o_slot]
+
+    # The trunk cache must be untouched: advancing the trunk afterwards
+    # still matches the oracle.
+    t_next = tpu.advance_and_propose([0], [roundtrip[0]])
+    o_next = oracle.advance_and_propose([0], [roundtrip[0]])
+    assert [c.token_id for c in t_next[0]] == [c.token_id for c in o_next[0]]
+
+
+def test_suffix_propose_requires_trunk_session(backend):
+    spec = make_spec(n_slots=2, sample=False)
+    session = TPUTokenSearchSession(backend, spec)
+    session.propose()
+    with pytest.raises(ValueError):
+        session.propose_suffixes([[]], salt=0)
+
+
+def test_finite_lookahead_runs_on_tpu_session(backend):
+    from consensus_tpu.methods import get_method_generator
+
+    issue = "Should the town build a new library?"
+    opinions = {
+        "Agent 1": "Yes, libraries anchor the community.",
+        "Agent 2": "Only if it does not raise taxes.",
+    }
+    cfg = {"branching_factor": 2, "max_depth": 2, "max_tokens": 5, "seed": 4}
+    gen = get_method_generator("finite_lookahead", backend, cfg)
+    statement = gen.generate_statement(issue, opinions)
+    assert isinstance(statement, str)
+    gen2 = get_method_generator("finite_lookahead", backend, cfg)
+    assert gen2.generate_statement(issue, opinions) == statement
+
+
 def test_beam_search_runs_on_tpu_session(backend):
     from consensus_tpu.methods import get_method_generator
 

@@ -99,14 +99,35 @@ class TestFiniteLookahead:
         s2 = self.make(FakeBackend()).generate_statement(ISSUE, OPINIONS)
         assert s1 == s2
 
-    def test_tree_paths_level_batching(self, backend):
-        gen = self.make(backend)
-        paths = gen._tree_paths(ISSUE, OPINIONS, "", 2, 3, 1.0, seed=1)
-        # One batched call per level: frontier sizes 1, 2, 4 -> 7 requests
-        # but only 3 next_token CALL batches happen; counts track requests.
-        assert backend.call_counts["next_token"] == 1 + 2 + 4
-        assert 1 <= len(paths) <= 8
-        assert all(isinstance(p, list) and p for p in paths)
+    def test_tree_level_batching(self, backend):
+        from consensus_tpu.backends.session import SearchSpec, open_token_search
+        from consensus_tpu.methods.finite_lookahead import FiniteLookaheadGenerator
+        from consensus_tpu.methods.prompts import agent_prompt, reference_prompt
+
+        system, user = reference_prompt(ISSUE, OPINIONS, variant="finite_lookahead")
+        session = open_token_search(
+            backend,
+            SearchSpec(
+                ref_system=system, ref_user=user,
+                agent_prompts=tuple(
+                    agent_prompt(ISSUE, o, variant="finite_lookahead")
+                    for o in OPINIONS.values()
+                ),
+                n_slots=1, k=2, seed=1, max_steps=4,
+            ),
+        )
+        root = session.propose()[0]
+        best = FiniteLookaheadGenerator._best_path(
+            session, root, branching=2, max_depth=3, step=0
+        )
+        # Level-batched tree: root (1 request) + frontier levels of <=2 and
+        # <=4 paths — one batched next_token call per level, counts track
+        # requests.
+        assert 1 <= backend.call_counts["next_token"] <= 1 + 2 + 4
+        assert best is not None
+        path, sums = best
+        assert 1 <= len(path) <= 3
+        assert len(sums) == len(OPINIONS)
 
     def test_appends_only_first_token_per_step(self, backend):
         gen = self.make(backend, max_tokens=1)
