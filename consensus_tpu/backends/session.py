@@ -112,17 +112,73 @@ class PrefixTokenSearchSession:
         ]
         return self._proposals_for(prefixes, family=1, index=salt)
 
+    def rollout_from(
+        self, suffix: Sequence[ScoredCandidate], depth: int, salt: int
+    ) -> Tuple[List[int], str, List[float], bool]:
+        """Continue ``depth`` reference-policy tokens past trunk+suffix and
+        return (rollout token ids, rollout text, per-agent total logprob of
+        the rollout tokens, ok).  Fallback: one generate call + one batched
+        score call."""
+        from consensus_tpu.backends.base import GenerationRequest
+
+        spec = self.spec
+        if spec.n_slots != 1:
+            raise ValueError("rollout_from requires an n_slots=1 session")
+        prefix = self._sequences[0] + "".join(c.token for c in suffix)
+        seed = spec.seed
+        result = self.backend.generate(
+            [
+                GenerationRequest(
+                    user_prompt=spec.ref_user + prefix,
+                    system_prompt=spec.ref_system,
+                    max_tokens=depth,
+                    temperature=spec.temperature,
+                    # Family 2 = rollouts (0 = trunk steps, 1 = suffix
+                    # proposals) in the injective (seed, family, index, row)
+                    # seed map of _proposals_for.
+                    seed=((seed * 3 + 2) * 1_000_000_000 + salt * 1000)
+                    if seed is not None
+                    else None,
+                    chat=False,
+                )
+            ]
+        )[0]
+        if not result.ok:
+            return [], "", [], False
+        if not result.text:
+            return [], "", [0.0] * len(spec.agent_prompts), True
+        scores = self.backend.score(
+            [
+                ScoreRequest(
+                    context=a_user + prefix,
+                    continuation=result.text,
+                    system_prompt=a_system,
+                    chat=False,
+                )
+                for a_system, a_user in spec.agent_prompts
+            ]
+        )
+        totals = [
+            (sum(s.logprobs) if s.ok else spec.failure_logprob) for s in scores
+        ]
+        return list(result.token_ids), result.text, totals, True
+
     # -- internals -----------------------------------------------------------
 
     def _proposals_for(
         self, prefixes: Sequence[str], family: int, index: int
     ) -> List[List[ScoredCandidate]]:
         """One batched next-token call over ``prefixes`` + one batched score
-        call over (prefix x candidate x agent).  ``(family, index, row)``
-        triples map injectively onto request seeds, so no two requests in a
-        session ever share one."""
+        call over (prefix x candidate x agent).  ``(seed, family, index,
+        row)`` tuples map injectively onto request seeds (index < 1e6 —
+        generous for salts/steps; row < 1000 — far above any path fan-out),
+        so no two seeded requests across a seed sweep ever collide."""
         spec = self.spec
         seed = spec.seed
+        if not (0 <= index < 1_000_000 and len(prefixes) <= 1000):
+            raise ValueError(
+                f"seed-map bounds exceeded: index={index}, rows={len(prefixes)}"
+            )
         requests = [
             NextTokenRequest(
                 user_prompt=spec.ref_user + prefix,
@@ -130,7 +186,7 @@ class PrefixTokenSearchSession:
                 k=spec.k,
                 temperature=spec.temperature,
                 seed=(
-                    (seed * 2 + family) * 1_000_000 + index * 1000 + row
+                    (seed * 3 + family) * 1_000_000_000 + index * 1000 + row
                 )
                 if seed is not None
                 else None,

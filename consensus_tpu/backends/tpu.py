@@ -68,9 +68,27 @@ _STREAMED_VOCAB_THRESHOLD = 32_768
 _SESSION_CACHE_BYTES_CAP = 8 * 1024**3
 
 
+class _SessionOverCap(Exception):
+    """Raised by TPUTokenSearchSession when its cache would exceed the cap."""
+
+
 def _bucket(n: int, minimum: int = 32) -> int:
     size = minimum
     while size < n:
+        size *= 2
+    return size
+
+
+def _width_bucket(n: int, minimum: int = 128) -> int:
+    """Sequence-length bucket on a {1, 1.5} x power-of-two ladder
+    (128, 192, 256, 384, 512, ...).  Rows bucket to powers of two, but
+    widths deserve the finer ladder: a 350-token scoring prompt padded to
+    512 wastes 32% of a compute-bound forward, padded to 384 only 9%.
+    Ladder steps stay multiples of the 128-lane TPU tile."""
+    size = minimum
+    while size < n:
+        if size + size // 2 >= n:
+            return size + size // 2
         size *= 2
     return size
 
@@ -171,7 +189,7 @@ class TPUBackend:
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """(tokens, valid) left-padded into a shared length bucket."""
         longest = min(max(len(t) for t in token_lists), self.max_context)
-        width = min(_bucket(longest), self.max_context)
+        width = min(_width_bucket(longest), self.max_context)
         pad = self.tokenizer.pad_id
         tokens = np.full((len(token_lists), width), pad, np.int32)
         valid = np.zeros((len(token_lists), width), bool)
@@ -360,7 +378,7 @@ class TPUBackend:
         # by the result loop below.
         rows += [[]] * (_bucket(len(rows), minimum=8) - len(rows))
         longest = min(max(len(r) for r in rows), self.max_context)
-        width = min(_bucket(longest), self.max_context)
+        width = min(_width_bucket(longest), self.max_context)
         pad = self.tokenizer.pad_id
         tokens = np.full((len(rows), width), pad, np.int32)
         valid = np.zeros((len(rows), width), bool)
@@ -498,26 +516,19 @@ class TPUBackend:
         """Incremental KV-cache search session (models/stepper.py): one fused
         device program per emitted token instead of re-running every prefix.
         Falls back to the generic full-prefix session when the persistent
-        caches wouldn't fit alongside the weights."""
+        caches wouldn't fit alongside the weights (the session sizes its
+        cache from the ACTUAL tokenized prefix width, so the check happens
+        in its constructor, not on a pessimistic pre-tokenize bound)."""
         from consensus_tpu.backends.session import PrefixTokenSearchSession
 
-        c = self.config
-        n_rows = spec.n_slots * (1 + len(spec.agent_prompts))
-        # Upper bound before tokenizing: prefix bucket <= max_context, plus
-        # one cache column per step, at the cache's actual dtype width.
-        width_guess = self.max_context + spec.max_steps
-        itemsize = jnp.dtype(self.params["embed"].dtype).itemsize
-        cache_bytes = (
-            2 * c.n_layers * n_rows * width_guess * c.n_kv_heads * c.head_dim
-            * itemsize
-        )
-        if cache_bytes > _SESSION_CACHE_BYTES_CAP:
+        try:
+            return TPUTokenSearchSession(self, spec)
+        except _SessionOverCap as over:
             logger.warning(
-                "open_token_search: %d-row cache (~%.1f GB) over cap — using "
-                "full-prefix fallback session", n_rows, cache_bytes / 1e9,
+                "open_token_search: %s — using full-prefix fallback session",
+                over,
             )
             return PrefixTokenSearchSession(self, spec)
-        return TPUTokenSearchSession(self, spec)
 
     # -- embeddings ------------------------------------------------------------
 
@@ -590,6 +601,18 @@ class TPUTokenSearchSession:
         self._tokens, self._valid = backend._left_pad_batch(token_lists)
         self._w0 = int(self._tokens.shape[1])
         self.n_roles = len(prefixes)
+        c = backend.config
+        n_rows = spec.n_slots * self.n_roles
+        itemsize = jnp.dtype(backend.params["embed"].dtype).itemsize
+        cache_bytes = (
+            2 * c.n_layers * n_rows * (self._w0 + spec.max_steps)
+            * c.n_kv_heads * c.head_dim * itemsize
+        )
+        if cache_bytes > _SESSION_CACHE_BYTES_CAP:
+            raise _SessionOverCap(
+                f"{n_rows}-row x {self._w0 + spec.max_steps}-wide session "
+                f"cache (~{cache_bytes / 1e9:.1f} GB) over cap"
+            )
         self._step = 0
         self._cache = None
         self._cur_pos = None
@@ -698,6 +721,42 @@ class TPUTokenSearchSession:
             )
         )[: len(suffixes)]
         return self._unpack(packed)
+
+    def rollout_from(
+        self, suffix: Sequence, depth: int, salt: int
+    ) -> Tuple[List[int], str, List[float], bool]:
+        """Continue ``depth`` reference-policy tokens past trunk+suffix and
+        return (rollout token ids, rollout text, per-agent total logprob of
+        the rollout tokens, ok) — the MCTS rollout + evaluation as ONE
+        device call (models/stepper.py:rollout_scored).  Trunk sessions
+        only.  The ids are authoritative (arbitrary sampled bytes need not
+        survive a decode/encode round trip); the text is for display."""
+        from consensus_tpu.models.stepper import rollout_scored
+
+        spec = self.spec
+        if spec.n_slots != 1:
+            raise ValueError("rollout_from requires an n_slots=1 session")
+        if self._cache is None:
+            raise ValueError("call propose() before rollout_from()")
+        if not suffix:
+            raise ValueError("rollout_from needs a non-empty suffix")
+        rows = np.asarray(
+            rollout_scored(
+                self.backend.params, self.backend.config,
+                self._cache, self._cur_pos,
+                jnp.asarray([c.token_id for c in suffix], jnp.int32),
+                jnp.asarray([salt, self._w0 + self._step], jnp.int32),
+                self.n_roles, len(suffix), depth,
+                self._base_key, self._temperature,
+                jnp.asarray(self.backend.tokenizer.eos_ids, jnp.int32),
+            )
+        )  # (depth, 2 + A)
+        counted = rows[:, 1] > 0.5
+        tok = self.backend.tokenizer
+        ids = [int(rows[t, 0]) for t in range(depth) if counted[t]]
+        text = "".join(tok.token_str(i) for i in ids)
+        totals = [float(v) for v in rows[counted, 2:].sum(axis=0)]
+        return ids, text, totals, True
 
     # -- internals -----------------------------------------------------------
 

@@ -1,4 +1,4 @@
-"""Monte-Carlo tree search decoder (UCB1 + rollouts), batched per phase.
+"""Monte-Carlo tree search decoder (UCB1 + rollouts) over a trunk session.
 
 Reference: ``src/methods/mcts.py`` (1 044 LoC; SURVEY §2.6/§3.4).  Search
 semantics preserved:
@@ -22,20 +22,25 @@ semantics preserved:
 evaluation raises ``NameError`` on a stale f-string variable (mcts.py:614-616)
 and aborts every MCTS run; this implementation evaluates rollouts correctly.
 
-Cost redesign: expansion token proposal is one exact ``next_token_logprobs``
-call instead of a rejection-sampling loop (reference :165-247), and each
-evaluation batches all agents into one ``score`` call.
+Cost redesign: the whole statement drives ONE trunk session
+(backends/session.py).  Each expansion is a single propose_suffixes call —
+the k proposals AND their per-agent scores come out of one forward over the
+shared trunk cache — and each rollout+evaluation is a single rollout_scored
+call (sample ``rollout_depth`` tokens, score every one under every agent
+from the same logits).  The rolled-out statement's total agent logprob
+telescopes as trunk-sum + node-path-sum + rollout-sum by the chain rule,
+replacing the reference's full-statement re-scoring.
 """
 
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
-from consensus_tpu.backends.base import (
-    GenerationRequest,
-    NextTokenRequest,
-    ScoreRequest,
+from consensus_tpu.backends.session import (
+    ScoredCandidate,
+    SearchSpec,
+    open_token_search,
 )
 from consensus_tpu.methods.base import BaseGenerator
 from consensus_tpu.methods.beam_search import BIAS_AGAINST_TOKENS, EOS_TOKENS
@@ -47,8 +52,7 @@ FAILURE_REWARD = -100.0
 
 class Node:
     __slots__ = (
-        "statement",
-        "token",
+        "cand",
         "parent",
         "children",
         "visits",
@@ -58,20 +62,34 @@ class Node:
         "is_terminal",
     )
 
-    def __init__(self, statement: str, token: Optional[str], parent: Optional["Node"]):
-        self.statement = statement
-        self.token = token
+    def __init__(self, cand: Optional[ScoredCandidate], parent: Optional["Node"]):
+        self.cand = cand
         self.parent = parent
         self.children: Dict[str, Node] = {}
         self.visits = 0
         self.total_reward = 0.0
         self.immediate_reward = 0.0
-        self.untried: Optional[List] = None  # None = never expanded
-        self.is_terminal = token in EOS_TOKENS if token is not None else False
+        self.untried: Optional[List[ScoredCandidate]] = None
+        self.is_terminal = cand.token in EOS_TOKENS if cand is not None else False
 
     @property
     def value(self) -> float:
         return self.total_reward / self.visits if self.visits else 0.0
+
+    def suffix(self) -> List[ScoredCandidate]:
+        """Token path from the session trunk (the current root) to here."""
+        path: List[ScoredCandidate] = []
+        node = self
+        while node.parent is not None:
+            path.append(node.cand)
+            node = node.parent
+        return path[::-1]
+
+    def path_agent_sums(self, n_agents: int) -> List[float]:
+        path = self.suffix()
+        return [
+            sum(c.agent_logprobs[a] for c in path) for a in range(n_agents)
+        ]
 
 
 class MCTSGenerator(BaseGenerator):
@@ -83,27 +101,49 @@ class MCTSGenerator(BaseGenerator):
         self._width = int(cfg.get("expansion_sample_width", 5))
         self._rollout_depth = int(cfg.get("rollout_depth", 10))
         self._gamma = float(cfg.get("gamma", 0.99))
-        self._temperature = float(cfg.get("temperature", 1.0))
+        temperature = float(cfg.get("temperature", 1.0))
 
-        self._issue = issue
-        self._agents = list(agent_opinions.items())
-        self._agent_opinions = agent_opinions
-        if not self._agents:
+        agents = list(agent_opinions.items())
+        if not agents:
             return ""
+        self._n_agents = len(agents)
 
-        root = Node("", None, None)
+        system, user = reference_prompt(issue, agent_opinions, variant="mcts")
+        self._session = open_token_search(
+            self.backend,
+            SearchSpec(
+                ref_system=system,
+                ref_user=user,
+                agent_prompts=tuple(
+                    agent_prompt(issue, opinion, variant="mcts")
+                    for _, opinion in agents
+                ),
+                n_slots=1,  # trunk session: root state lives on device
+                k=self._width,
+                temperature=temperature,
+                seed=self.seed,
+                sample=True,
+                bias_against_tokens=BIAS_AGAINST_TOKENS,
+                max_steps=max_tokens,
+                failure_logprob=FAILURE_REWARD,
+            ),
+        )
+        self._salt = 0
+
+        statement = ""
+        #: Per-agent total logprob of the trunk tokens emitted so far — the
+        #: telescoped prefix of every rollout evaluation.
+        trunk_sums = [0.0] * self._n_agents
+        root = Node(None, None)
+        root.untried = list(self._session.propose()[0])
+
         for step in range(max_tokens):
-            for sim in range(self._num_simulations):
-                sim_seed = (
-                    self.seed + step * 10_000 + sim
-                    if self.seed is not None
-                    else None
-                )
+            for _sim in range(self._num_simulations):
                 leaf = self._select(root)
                 if leaf.is_terminal:
                     reward, target = leaf.immediate_reward, leaf
                 else:
-                    child = self._expand_and_evaluate(leaf, sim_seed)
+                    child = self._expand_and_evaluate(leaf, trunk_sums)
                     if child is None:  # fully expanded with zero candidates
                         reward, target = leaf.immediate_reward, leaf
                     else:
@@ -113,12 +153,23 @@ class MCTSGenerator(BaseGenerator):
             best = self._most_visited_child(root)
             if best is None:
                 break
+            statement += best.cand.token
+            # Advance the trunk: the chosen child becomes the root; its
+            # subtree survives with suffixes implicitly rebased (suffix()
+            # walks only to the new root).
+            trunk_sums = [
+                s + lp for s, lp in zip(trunk_sums, best.cand.agent_logprobs)
+            ]
+            chosen = best.cand
             best.parent = None  # detach (reference :1005-1006)
             root = best
-            if root.is_terminal:
+            if root.is_terminal or step == max_tokens - 1:
                 break
+            new_proposals = self._session.advance_and_propose([0], [chosen])[0]
+            if root.untried is None:
+                root.untried = list(new_proposals)
 
-        statement = root.statement.strip()
+        statement = statement.strip()
         self.pre_brushup_statement = statement
         if cfg.get("brushup", False):
             statement = brushup_statement_ending(
@@ -146,91 +197,43 @@ class MCTSGenerator(BaseGenerator):
             )
         return node
 
-    def _expand_and_evaluate(self, node: Node, seed) -> Optional[Node]:
+    def _expand_and_evaluate(
+        self, node: Node, trunk_sums: List[float]
+    ) -> Optional[Node]:
         if node.untried is None:
-            node.untried = self._propose_tokens(node.statement, seed)
+            self._salt += 1
+            node.untried = list(
+                self._session.propose_suffixes([node.suffix()], self._salt)[0]
+            )
         if not node.untried:
             return None
         candidate = node.untried.pop(0)
-        child = Node(node.statement + candidate.token, candidate.token, node)
+        child = Node(candidate, node)
         node.children[candidate.token] = child
 
-        immediate = self._agent_min_token_logprob(node.statement, candidate.token)
+        # Egalitarian immediate reward: min over agents of the new token's
+        # logprob — delivered by the proposal itself (reference :249-329).
+        immediate = min(candidate.agent_logprobs)
         if child.is_terminal:
             child.immediate_reward = immediate
         else:
-            rollout_value = self._rollout(child.statement, seed)
+            rollout_value = self._rollout_value(child, trunk_sums)
             child.immediate_reward = immediate + self._gamma * rollout_value
         return child
 
-    def _propose_tokens(self, statement: str, seed) -> List:
-        system, user = reference_prompt(self._issue, self._agent_opinions, variant="mcts")
-        return self.backend.next_token_logprobs(
-            [
-                NextTokenRequest(
-                    user_prompt=user + statement,
-                    system_prompt=system,
-                    k=self._width,
-                    temperature=self._temperature,
-                    seed=seed,
-                    mode="sample",
-                    bias_against_tokens=BIAS_AGAINST_TOKENS,
-                    chat=False,
-                )
-            ]
-        )[0]
-
-    def _agent_min_token_logprob(self, statement: str, token: str) -> float:
-        """Egalitarian immediate reward: min over agents of the token's
-        logprob (one batched score call; reference :249-329)."""
-        requests = [
-            ScoreRequest(
-                context=agent_prompt(self._issue, opinion, variant="mcts")[1] + statement,
-                continuation=token,
-                system_prompt=agent_prompt(self._issue, opinion, variant="mcts")[0],
-                chat=False,
-            )
-            for _, opinion in self._agents
-        ]
-        results = self.backend.score(requests)
-        rewards = [
-            (r.logprobs[-1] if r.ok else FAILURE_REWARD) for r in results
-        ]
-        return min(rewards) if rewards else FAILURE_REWARD
-
-    def _rollout(self, statement: str, seed) -> float:
-        """Continue ``rollout_depth`` tokens from the reference policy, then
-        value the rolled-out statement as min over agents of its TOTAL
-        logprob (reference :470-651; evaluated correctly — the reference
-        crashes here, SURVEY §2.6)."""
-        system, user = reference_prompt(self._issue, self._agent_opinions, variant="mcts")
-        rollout = self.backend.generate(
-            [
-                GenerationRequest(
-                    user_prompt=user + statement,
-                    system_prompt=system,
-                    max_tokens=self._rollout_depth,
-                    temperature=self._temperature,
-                    seed=seed,
-                    chat=False,
-                )
-            ]
-        )[0]
-        if not rollout.ok:
+    def _rollout_value(self, child: Node, trunk_sums: List[float]) -> float:
+        """Min over agents of the rolled-out statement's TOTAL logprob
+        (reference :470-651): trunk + node path + rollout sums telescope."""
+        self._salt += 1
+        _ids, _text, rollout_sums, ok = self._session.rollout_from(
+            child.suffix(), self._rollout_depth, self._salt
+        )
+        if not ok:
             return FAILURE_REWARD
-        full_statement = statement + rollout.text
-
-        requests = [
-            ScoreRequest(
-                context=agent_prompt(self._issue, opinion, variant="mcts")[1],
-                continuation=full_statement,
-                system_prompt=agent_prompt(self._issue, opinion, variant="mcts")[0],
-                chat=False,
-            )
-            for _, opinion in self._agents
+        path_sums = child.path_agent_sums(self._n_agents)
+        totals = [
+            t + p + r for t, p, r in zip(trunk_sums, path_sums, rollout_sums)
         ]
-        results = self.backend.score(requests)
-        totals = [r.total(default=FAILURE_REWARD) for r in results]
         return min(totals) if totals else FAILURE_REWARD
 
     @staticmethod

@@ -240,3 +240,93 @@ def suffix_propose(
         n_paths, n_roles, base_key, salt, temperature, k, sample, ref_bias,
         key_family=1,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "n_roles", "suffix_len", "depth"),
+)
+def rollout_scored(
+    params,
+    config: ModelConfig,
+    cache: KVCache,  # trunk cache, n_roles rows (NOT consumed — copied)
+    cur_pos: jax.Array,  # (n_roles,) int32
+    suffix_tokens: jax.Array,  # (suffix_len,) int32 — the node's path
+    meta: jax.Array,  # (2,) int32: [salt, write_index]
+    n_roles: int,
+    suffix_len: int,
+    depth: int,
+    base_key: jax.Array,  # (2,)
+    temperature: jax.Array,
+    eos_ids: jax.Array,  # (E,) int32
+) -> jax.Array:
+    """MCTS rollout valued in ONE device call: continue ``depth`` tokens from
+    the reference policy past trunk+suffix, scoring each sampled token under
+    every agent from the same logits.  Returns packed (depth, 2 + A) f32
+    rows [token_id, counted, agent_logprobs...]; ``counted`` is 0 from the
+    first EOS on (matching generate()'s EOS-excluded text).  The trunk cache
+    is copied into a widened scratch, so the session state is untouched.
+    Replaces the reference's rollout + per-agent full-statement scoring
+    (mcts.py:470-651) — the call that its own NameError bug aborts.
+    """
+    salt, write_index = meta[0], meta[1]
+    extra = suffix_len + depth
+    pad = ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0))
+    scratch = KVCache(
+        k=jnp.pad(cache.k, pad),
+        v=jnp.pad(cache.v, pad),
+        key_positions=jnp.pad(cache.key_positions, ((0, 0), (0, extra))),
+        key_valid=jnp.pad(cache.key_valid, ((0, 0), (0, extra))),
+    )
+
+    tokens = jnp.tile(suffix_tokens[None, :], (n_roles, 1))
+    positions = cur_pos[:, None] + 1 + jnp.arange(suffix_len)[None, :]
+    logits, scratch = forward(
+        params, config, tokens, positions,
+        jnp.ones((n_roles, suffix_len), jnp.bool_), scratch, write_index,
+    )
+    rollout_key = jax.random.fold_in(jax.random.fold_in(base_key, 2), salt)
+
+    def step(carry, t):
+        logits_last, cache_t, pos, done = carry
+        lp = jax.nn.log_softmax(logits_last.astype(jnp.float32), axis=-1)
+        key = jax.random.fold_in(rollout_key, t)
+        sampled = jax.random.categorical(
+            key, lp[0] / jnp.maximum(temperature, 1e-6)
+        )
+        token = jnp.where(temperature <= 0.0, jnp.argmax(lp[0]), sampled)
+        is_eos = (
+            jnp.any(token == eos_ids)
+            if eos_ids.shape[0]
+            else jnp.asarray(False)
+        )
+        counted = ~done & ~is_eos
+        agent_lps = lp[1:, token]  # (A,)
+        new_done = done | is_eos
+
+        pos = pos + 1
+        step_logits, new_cache = forward(
+            params, config,
+            jnp.full((n_roles, 1), token, jnp.int32),
+            pos[:, None],
+            jnp.broadcast_to(~done, (n_roles,))[:, None],
+            cache_t,
+            write_index + suffix_len + t,
+        )
+        out_row = jnp.concatenate(
+            [
+                token.astype(jnp.float32)[None],
+                counted.astype(jnp.float32)[None],
+                jnp.where(counted, agent_lps, 0.0),
+            ]
+        )
+        return (step_logits[:, -1, :], new_cache, pos, new_done), out_row
+
+    init = (
+        logits[:, -1, :],
+        scratch,
+        positions[:, -1],
+        jnp.asarray(False),
+    )
+    _, rows = jax.lax.scan(step, init, jnp.arange(depth))
+    return rows  # (depth, 2 + A)

@@ -67,6 +67,33 @@ def test_sharded_scoring_matches_single_device(tiny_config):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-4)
 
 
+def test_token_search_session_under_tp_mesh():
+    """The incremental search session (beam search driver) produces the same
+    statement whether the backend's params are tensor-sharded or not — the
+    session's fused step programs must partition cleanly over the mesh."""
+    from consensus_tpu.backends.tpu import TPUBackend
+    from consensus_tpu.methods import get_method_generator
+
+    issue = "Should the town build a new library?"
+    opinions = {
+        "Agent 1": "Yes, libraries anchor the community.",
+        "Agent 2": "Only if it does not raise taxes.",
+    }
+    cfg = {"beam_width": 2, "max_tokens": 5, "seed": 7}
+
+    single = TPUBackend(model="tiny-gemma2", dtype="float32", max_context=256)
+    sharded = TPUBackend(
+        model="tiny-gemma2", dtype="float32", max_context=256, tp=2
+    )
+    s1 = get_method_generator("beam_search", single, cfg).generate_statement(
+        issue, opinions
+    )
+    s2 = get_method_generator("beam_search", sharded, cfg).generate_statement(
+        issue, opinions
+    )
+    assert s1 == s2
+
+
 def test_train_step_runs_and_reduces_loss(tiny_config):
     config = tiny_config
     plan = make_mesh(tp=2)

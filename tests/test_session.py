@@ -192,6 +192,64 @@ def test_finite_lookahead_runs_on_tpu_session(backend):
     assert gen2.generate_statement(issue, opinions) == statement
 
 
+def test_rollout_from_matches_id_level_oracle(backend):
+    """Device rollout (one fused call) == teacher-forced scoring of the same
+    token-id sequence.  The oracle works at the id level: sampled bytes need
+    not round-trip through decoded strings (random weights emit non-UTF8
+    bytes whose decoded form re-encodes differently)."""
+    import jax.numpy as jnp
+
+    from consensus_tpu.models.transformer import token_logprobs
+
+    spec = make_spec(n_slots=1, sample=False, temperature=0.0, k=2)
+    tpu = TPUTokenSearchSession(backend, spec)
+    t_root = tpu.propose()[0]
+
+    start = t_root[0]
+    depth = 4
+    rollout_ids, t_text, t_totals, t_ok = tpu.rollout_from(
+        [start], depth=depth, salt=9
+    )
+    assert t_ok and len(t_totals) == len(spec.agent_prompts)
+
+    # Deterministic: the same call reproduces ids and totals exactly.
+    ids2, _, totals2, _ = tpu.rollout_from([start], depth=depth, salt=9)
+    assert (rollout_ids, t_totals) == (ids2, totals2)
+
+    tok = backend.tokenizer
+    if not rollout_ids:
+        pytest.skip("rollout hit EOS immediately")
+    for agent_index, (a_system, a_user) in enumerate(spec.agent_prompts):
+        prefix_ids = tok.encode(
+            tok.raw_prompt(a_user, a_system), add_bos=True
+        )
+        ids = prefix_ids + [start.token_id] + rollout_ids
+        arr = jnp.asarray([ids], jnp.int32)
+        valid = jnp.ones_like(arr, dtype=bool)
+        lps = np.asarray(token_logprobs(backend.params, backend.config, arr, valid))
+        oracle_total = lps[0, len(prefix_ids) + 1 :].sum()
+        np.testing.assert_allclose(t_totals[agent_index], oracle_total, atol=2e-3)
+
+
+def test_mcts_runs_on_tpu_session(backend):
+    from consensus_tpu.methods import get_method_generator
+
+    issue = "Should the town build a new library?"
+    opinions = {
+        "Agent 1": "Yes, libraries anchor the community.",
+        "Agent 2": "Only if it does not raise taxes.",
+    }
+    cfg = {
+        "num_simulations": 3, "expansion_sample_width": 2,
+        "max_tokens": 3, "rollout_depth": 2, "seed": 6,
+    }
+    gen = get_method_generator("mcts", backend, cfg)
+    statement = gen.generate_statement(issue, opinions)
+    assert isinstance(statement, str)
+    gen2 = get_method_generator("mcts", backend, cfg)
+    assert gen2.generate_statement(issue, opinions) == statement
+
+
 def test_beam_search_runs_on_tpu_session(backend):
     from consensus_tpu.methods import get_method_generator
 

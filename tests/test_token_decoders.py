@@ -162,20 +162,24 @@ class TestMCTS:
         assert s1 == s2
 
     def test_visits_accumulate(self, backend):
+        from consensus_tpu.backends.session import ScoredCandidate
         from consensus_tpu.methods.mcts import MCTSGenerator, Node
 
-        root = Node("", None, None)
-        child = Node("x", "x", root)
+        root = Node(None, None)
+        child = Node(ScoredCandidate("x", 1, -1.0, (-1.0,)), root)
         MCTSGenerator._backpropagate(child, 1.5)
         MCTSGenerator._backpropagate(child, 0.5)
         assert child.visits == 2 and root.visits == 2
         assert child.value == pytest.approx(1.0)
+        assert [c.token for c in child.suffix()] == ["x"]
 
     def test_most_visited_child_advances(self, backend):
+        from consensus_tpu.backends.session import ScoredCandidate
         from consensus_tpu.methods.mcts import MCTSGenerator, Node
 
-        root = Node("", None, None)
-        a, b = Node("a", "a", root), Node("b", "b", root)
+        root = Node(None, None)
+        a = Node(ScoredCandidate("a", 1, -1.0, (-1.0,)), root)
+        b = Node(ScoredCandidate("b", 2, -1.0, (-1.0,)), root)
         root.children = {"a": a, "b": b}
         a.visits, b.visits = 3, 7
         assert MCTSGenerator._most_visited_child(root) is b
