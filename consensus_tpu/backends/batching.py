@@ -73,6 +73,25 @@ class BatchingBackend:
         #: N concurrent runs << N× the solo batch count.
         self.batch_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
 
+    def open_fused_token_search(self, spec):
+        """Fused token-search sessions bypass the request queue: each session
+        step is already ONE fused device program on the inner backend, so
+        there is nothing to merge — and without this delegation a concurrent
+        sweep cell would silently fall back to the O(T^2) full-prefix
+        session.  The inner backend's session budget bounds how many run at
+        once; if the inner backend has no fused sessions (or declines the
+        spec), FusedSessionUnavailable propagates and the factory builds the
+        full-prefix fallback over THIS wrapper, keeping its calls merged
+        through the queue."""
+        from consensus_tpu.backends.session import FusedSessionUnavailable
+
+        maker = getattr(self.inner, "open_fused_token_search", None)
+        if maker is None:
+            raise FusedSessionUnavailable(
+                f"inner backend {self.inner.name!r} has no fused sessions"
+            )
+        return maker(spec)
+
     @contextlib.contextmanager
     def session(self):
         """Register the calling thread as an active run for flush accounting."""

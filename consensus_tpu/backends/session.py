@@ -36,6 +36,11 @@ from consensus_tpu.backends.base import (
 )
 
 
+class FusedSessionUnavailable(Exception):
+    """A backend's fused session implementation declined this spec (e.g. the
+    KV caches would not fit in device memory) — use the generic fallback."""
+
+
 class ScoredCandidate(NamedTuple):
     token: str
     token_id: int
@@ -75,6 +80,9 @@ class PrefixTokenSearchSession:
     def propose(self) -> List[List[ScoredCandidate]]:
         """Root proposals (every slot starts with the empty sequence)."""
         return self._propose_and_score()
+
+    def close(self) -> None:
+        """No device state to release in the full-prefix fallback."""
 
     def advance_and_propose(
         self, parents: Sequence[int], chosen: Sequence[ScoredCandidate]
@@ -248,9 +256,16 @@ class PrefixTokenSearchSession:
 
 
 def open_token_search(backend, spec: SearchSpec):
-    """Session factory: a backend's own ``open_token_search`` wins (TPU);
-    everything else gets the full-prefix fallback."""
-    maker = getattr(backend, "open_token_search", None)
+    """Session factory: a backend offering ``open_fused_token_search`` (TPU,
+    or the batching wrapper delegating to its inner TPU backend) gets first
+    refusal; on :class:`FusedSessionUnavailable` — or with no fused
+    implementation at all — the full-prefix fallback runs over ``backend``
+    ITSELF, so e.g. a batching wrapper keeps merging the fallback's calls
+    through its queue."""
+    maker = getattr(backend, "open_fused_token_search", None)
     if maker is not None:
-        return maker(spec)
+        try:
+            return maker(spec)
+        except FusedSessionUnavailable:
+            pass
     return PrefixTokenSearchSession(backend, spec)

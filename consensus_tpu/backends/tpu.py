@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -68,8 +69,27 @@ _STREAMED_VOCAB_THRESHOLD = 32_768
 _SESSION_CACHE_BYTES_CAP = 8 * 1024**3
 
 
-class _SessionOverCap(Exception):
-    """Raised by TPUTokenSearchSession when its cache would exceed the cap."""
+class _SessionBudget:
+    """HBM budget for LIVE session caches.  Concurrent sweep cells each hold
+    a session for a whole statement; unbounded, four wide-beam sessions plus
+    resident weights exceed a v5e chip's 16 GB.  Opening a session blocks
+    until its cache fits; closing releases the reservation."""
+
+    def __init__(self, cap_bytes: int):
+        self.cap = cap_bytes
+        self.used = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, nbytes: int) -> None:
+        with self._cond:
+            while self.used + nbytes > self.cap:
+                self._cond.wait()
+            self.used += nbytes
+
+    def release(self, nbytes: int) -> None:
+        with self._cond:
+            self.used -= nbytes
+            self._cond.notify_all()
 
 
 def _bucket(n: int, minimum: int = 32) -> int:
@@ -162,6 +182,10 @@ class TPUBackend:
         self._bias_id_cache: Dict[str, Tuple[int, ...]] = {}
         self.call_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
         self._unseeded_calls = 0
+        # Guards the unseeded-call nonce: concurrent sweep cells opening
+        # sessions/batches must never derive the same "fresh" stream.
+        self._nonce_lock = threading.Lock()
+        self._session_budget = _SessionBudget(_SESSION_CACHE_BYTES_CAP)
 
     # -- helpers -------------------------------------------------------------
 
@@ -258,9 +282,11 @@ class TPUBackend:
         keys = []
         for row, seed in enumerate(seeds):
             if seed is None:
-                self._unseeded_calls += 1
+                with self._nonce_lock:
+                    self._unseeded_calls += 1
+                    nonce = self._unseeded_calls
                 keys.append(
-                    self._fold_seed(kind, "unseeded", row, self._unseeded_calls)
+                    self._fold_seed(kind, "unseeded", row, nonce)
                 )
             else:
                 keys.append(self._fold_seed(kind, seed))
@@ -512,23 +538,15 @@ class TPUBackend:
 
     # -- token-search sessions -------------------------------------------------
 
-    def open_token_search(self, spec):
+    def open_fused_token_search(self, spec):
         """Incremental KV-cache search session (models/stepper.py): one fused
         device program per emitted token instead of re-running every prefix.
-        Falls back to the generic full-prefix session when the persistent
-        caches wouldn't fit alongside the weights (the session sizes its
-        cache from the ACTUAL tokenized prefix width, so the check happens
-        in its constructor, not on a pessimistic pre-tokenize bound)."""
-        from consensus_tpu.backends.session import PrefixTokenSearchSession
-
-        try:
-            return TPUTokenSearchSession(self, spec)
-        except _SessionOverCap as over:
-            logger.warning(
-                "open_token_search: %s — using full-prefix fallback session",
-                over,
-            )
-            return PrefixTokenSearchSession(self, spec)
+        Raises FusedSessionUnavailable when the persistent caches wouldn't
+        fit alongside the weights (the session sizes its cache from the
+        ACTUAL tokenized prefix width, so the check happens in its
+        constructor, not on a pessimistic pre-tokenize bound) — the factory
+        then builds the full-prefix fallback over the CALLING backend."""
+        return TPUTokenSearchSession(self, spec)
 
     # -- embeddings ------------------------------------------------------------
 
@@ -609,10 +627,24 @@ class TPUTokenSearchSession:
             * c.n_kv_heads * c.head_dim * itemsize
         )
         if cache_bytes > _SESSION_CACHE_BYTES_CAP:
-            raise _SessionOverCap(
+            from consensus_tpu.backends.session import FusedSessionUnavailable
+
+            logger.warning(
+                "fused session unavailable: %d-row x %d-wide cache "
+                "(~%.1f GB) over cap", n_rows, self._w0 + spec.max_steps,
+                cache_bytes / 1e9,
+            )
+            raise FusedSessionUnavailable(
                 f"{n_rows}-row x {self._w0 + spec.max_steps}-wide session "
                 f"cache (~{cache_bytes / 1e9:.1f} GB) over cap"
             )
+        # Reserve HBM for the lifetime of the session (blocks while other
+        # threads' sessions hold the budget); close() releases it.  The
+        # reservation is recorded only AFTER acquire succeeds: an exception
+        # inside a blocked acquire must not let __del__ release bytes that
+        # were never granted.
+        backend._session_budget.acquire(cache_bytes)
+        self._budget_bytes = cache_bytes
         self._step = 0
         self._cache = None
         self._cur_pos = None
@@ -622,9 +654,11 @@ class TPUTokenSearchSession:
         # step ships no key material.  Unseeded sessions draw a fresh nonce
         # (each session serves exactly one statement).
         if spec.seed is None:
-            backend._unseeded_calls += 1
+            with backend._nonce_lock:
+                backend._unseeded_calls += 1
+                nonce = backend._unseeded_calls
             self._base_key = backend._fold_seed(
-                "search", "unseeded", backend._unseeded_calls
+                "search", "unseeded", nonce
             )
         else:
             self._base_key = backend._fold_seed("search", spec.seed)
@@ -635,6 +669,7 @@ class TPUTokenSearchSession:
     def propose(self) -> List[List["ScoredCandidate"]]:
         from consensus_tpu.models.stepper import search_prefill
 
+        self._check_open()
         spec = self.spec
         out = search_prefill(
             self.backend.params, self.backend.config,
@@ -651,6 +686,7 @@ class TPUTokenSearchSession:
     ) -> List[List["ScoredCandidate"]]:
         from consensus_tpu.models.stepper import search_step
 
+        self._check_open()
         spec = self.spec
         if len(parents) != spec.n_slots or len(chosen) != spec.n_slots:
             raise ValueError(
@@ -692,6 +728,7 @@ class TPUTokenSearchSession:
         (n_slots == 1); the trunk itself advances via advance_and_propose."""
         from consensus_tpu.models.stepper import suffix_propose
 
+        self._check_open()
         spec = self.spec
         if spec.n_slots != 1:
             raise ValueError("propose_suffixes requires an n_slots=1 session")
@@ -733,6 +770,7 @@ class TPUTokenSearchSession:
         survive a decode/encode round trip); the text is for display."""
         from consensus_tpu.models.stepper import rollout_scored
 
+        self._check_open()
         spec = self.spec
         if spec.n_slots != 1:
             raise ValueError("rollout_from requires an n_slots=1 session")
@@ -758,7 +796,25 @@ class TPUTokenSearchSession:
         totals = [float(v) for v in rows[counted, 2:].sum(axis=0)]
         return ids, text, totals, True
 
+    def close(self) -> None:
+        """Drop the device caches and release the session's HBM reservation.
+        Idempotent; also runs at garbage collection as a safety net."""
+        # getattr: the constructor may raise before the reservation exists,
+        # and __del__ still runs.
+        if getattr(self, "_budget_bytes", 0):
+            self._cache = None
+            self._cur_pos = None
+            self.backend._session_budget.release(self._budget_bytes)
+            self._budget_bytes = 0
+
+    def __del__(self):
+        self.close()
+
     # -- internals -----------------------------------------------------------
+
+    def _check_open(self) -> None:
+        if not getattr(self, "_budget_bytes", 0):
+            raise ValueError("session is closed")
 
     def _finish(self, out) -> List[List["ScoredCandidate"]]:
         self._cache = out.cache

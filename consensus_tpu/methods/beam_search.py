@@ -120,34 +120,40 @@ class BeamSearchGenerator(BaseGenerator):
 
         beams: List[Beam] = [("", [0.0] * len(agents), 0)]
         completed: List[Tuple[str, List[float]]] = []
-        proposals = session.propose()
+        try:
+            proposals = session.propose()
 
-        for step in range(max_tokens):
-            candidates = []  # (new_sequence, new_rewards, candidate, parent_slot)
-            for sequence, cum_rewards, slot in beams:
-                for cand in proposals[slot]:
-                    new_rewards = [
-                        c + r for c, r in zip(cum_rewards, cand.agent_logprobs)
+            for step in range(max_tokens):
+                candidates = []  # (new_seq, new_rewards, candidate, parent_slot)
+                for sequence, cum_rewards, slot in beams:
+                    for cand in proposals[slot]:
+                        new_rewards = [
+                            c + r
+                            for c, r in zip(cum_rewards, cand.agent_logprobs)
+                        ]
+                        candidates.append(
+                            (sequence + cand.token, new_rewards, cand, slot)
+                        )
+                beams, completed = self._prune(candidates, completed, beam_width)
+                if not beams or step == max_tokens - 1:
+                    break
+                # Advance every session slot; slots beyond the surviving
+                # beams repeat the last survivor, proposals ignored.
+                parents: List[int] = []
+                chosen: List[ScoredCandidate] = []
+                new_beams: List[Beam] = []
+                for i in range(beam_width):
+                    sequence, rewards, cand, parent = beams[
+                        min(i, len(beams) - 1)
                     ]
-                    candidates.append(
-                        (sequence + cand.token, new_rewards, cand, slot)
-                    )
-            beams, completed = self._prune(candidates, completed, beam_width)
-            if not beams or step == max_tokens - 1:
-                break
-            # Advance every session slot; slots beyond the surviving beams
-            # repeat the last survivor and their proposals are ignored.
-            parents: List[int] = []
-            chosen: List[ScoredCandidate] = []
-            new_beams: List[Beam] = []
-            for i in range(beam_width):
-                sequence, rewards, cand, parent = beams[min(i, len(beams) - 1)]
-                parents.append(parent)
-                chosen.append(cand)
-                if i < len(beams):
-                    new_beams.append((sequence, rewards, i))
-            proposals = session.advance_and_propose(parents, chosen)
-            beams = new_beams
+                    parents.append(parent)
+                    chosen.append(cand)
+                    if i < len(beams):
+                        new_beams.append((sequence, rewards, i))
+                proposals = session.advance_and_propose(parents, chosen)
+                beams = new_beams
+        finally:
+            session.close()
 
         completed.extend((seq, rewards) for seq, rewards, *_ in beams)
         if not completed:

@@ -96,20 +96,23 @@ class FiniteLookaheadGenerator(BaseGenerator):
         )
 
         statement = ""
-        root_proposals = session.propose()[0]
-        for step in range(max_tokens):
-            best = self._best_path(
-                session, root_proposals, branching, max_depth, step
-            )
-            if best is None:
-                break
-            first = best[0][0]
-            if first.token in TERMINATOR_TOKENS:
-                break
-            statement += first.token
-            if step == max_tokens - 1:
-                break
-            root_proposals = session.advance_and_propose([0], [first])[0]
+        try:
+            root_proposals = session.propose()[0]
+            for step in range(max_tokens):
+                best = self._best_path(
+                    session, root_proposals, branching, max_depth, step
+                )
+                if best is None:
+                    break
+                first = best[0][0]
+                if first.token in TERMINATOR_TOKENS:
+                    break
+                statement += first.token
+                if step == max_tokens - 1:
+                    break
+                root_proposals = session.advance_and_propose([0], [first])[0]
+        finally:
+            session.close()
 
         statement = statement.strip()
         self.pre_brushup_statement = statement

@@ -130,6 +130,18 @@ class MCTSGenerator(BaseGenerator):
         )
         self._salt = 0
 
+        try:
+            statement = self._search(max_tokens)
+        finally:
+            self._session.close()
+        self.pre_brushup_statement = statement
+        if cfg.get("brushup", False):
+            statement = brushup_statement_ending(
+                self.backend, statement, seed=self.seed
+            )
+        return statement
+
+    def _search(self, max_tokens: int) -> str:
         statement = ""
         #: Per-agent total logprob of the trunk tokens emitted so far — the
         #: telescoped prefix of every rollout evaluation.
@@ -169,13 +181,7 @@ class MCTSGenerator(BaseGenerator):
             if root.untried is None:
                 root.untried = list(new_proposals)
 
-        statement = statement.strip()
-        self.pre_brushup_statement = statement
-        if cfg.get("brushup", False):
-            statement = brushup_statement_ending(
-                self.backend, statement, seed=self.seed
-            )
-        return statement
+        return statement.strip()
 
     # -- phases --------------------------------------------------------------
 

@@ -168,6 +168,60 @@ def test_suffix_propose_matches_full_prefix(backend):
     assert [c.token_id for c in t_next[0]] == [c.token_id for c in o_next[0]]
 
 
+def test_batching_backend_delegates_sessions_to_inner(backend):
+    """A concurrent sweep cell must get the fast inner-session path through
+    the generic factory (the call decoders make), not the O(T^2) fallback
+    over the batching queue."""
+    from consensus_tpu.backends.batching import BatchingBackend
+
+    batching = BatchingBackend(backend)
+    session = open_token_search(batching, make_spec())
+    assert isinstance(session, TPUTokenSearchSession)
+    assert session.backend is backend
+    session.close()
+    # Over-cap spec: the fallback must run over the WRAPPER so its calls
+    # keep merging through the batching queue.
+    fallback = open_token_search(batching, make_spec(n_slots=100_000))
+    assert isinstance(fallback, PrefixTokenSearchSession)
+    assert fallback.backend is batching
+
+
+def test_session_budget_blocks_then_releases(backend):
+    import threading
+
+    from consensus_tpu.backends.tpu import _SessionBudget
+
+    budget = _SessionBudget(100)
+    budget.acquire(70)
+    acquired = threading.Event()
+
+    def second():
+        budget.acquire(60)
+        acquired.set()
+        budget.release(60)
+
+    t = threading.Thread(target=second)
+    t.start()
+    assert not acquired.wait(0.2)  # 70 + 60 > 100: blocked
+    budget.release(70)
+    assert acquired.wait(2.0)
+    t.join()
+    assert budget.used == 0
+
+
+def test_closed_session_rejects_use_and_releases_budget(backend):
+    spec = make_spec()
+    before = backend._session_budget.used
+    session = TPUTokenSearchSession(backend, spec)
+    assert backend._session_budget.used > before
+    session.propose()
+    session.close()
+    assert backend._session_budget.used == before
+    session.close()  # idempotent
+    with pytest.raises(ValueError):
+        session.propose()
+
+
 def test_suffix_propose_requires_trunk_session(backend):
     spec = make_spec(n_slots=2, sample=False)
     session = TPUTokenSearchSession(backend, spec)
