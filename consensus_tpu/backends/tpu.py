@@ -68,6 +68,12 @@ _STREAMED_VOCAB_THRESHOLD = 32_768
 #: exhaustion — fall back to the cacheless full-prefix session instead.
 _SESSION_CACHE_BYTES_CAP = 8 * 1024**3
 
+#: v5e HBM (15.75 GB usable) and the live-budget floor/reserve used to size
+#: the concurrent-session budget against the resident weights.
+_HBM_BYTES = 15 * 1024**3
+_ACTIVATION_RESERVE_BYTES = 3 * 1024**3
+_SESSION_MIN_BUDGET_BYTES = 1 * 1024**3
+
 
 class _SessionBudget:
     """HBM budget for LIVE session caches.  Concurrent sweep cells each hold
@@ -185,7 +191,26 @@ class TPUBackend:
         # Guards the unseeded-call nonce: concurrent sweep cells opening
         # sessions/batches must never derive the same "fresh" stream.
         self._nonce_lock = threading.Lock()
-        self._session_budget = _SessionBudget(_SESSION_CACHE_BYTES_CAP)
+        # Live-session HBM budget: what a v5e chip holds after the resident
+        # weights and a reserve for per-call activation transients (merged
+        # score/generate batches run concurrently with session steps).
+        # PER-CHIP accounting: under tensor parallelism both the weights and
+        # the session KV caches shard over the mesh.
+        self._shard_count = (
+            self.mesh_plan.mesh.devices.size if self.mesh_plan else 1
+        )
+        params_bytes = sum(
+            x.size * jnp.dtype(x.dtype).itemsize
+            for x in jax.tree_util.tree_leaves(self.params)
+        ) // self._shard_count
+        budget = min(
+            _SESSION_CACHE_BYTES_CAP,
+            max(
+                _SESSION_MIN_BUDGET_BYTES,
+                _HBM_BYTES - params_bytes - _ACTIVATION_RESERVE_BYTES,
+            ),
+        )
+        self._session_budget = _SessionBudget(budget)
 
     # -- helpers -------------------------------------------------------------
 
@@ -622,21 +647,34 @@ class TPUTokenSearchSession:
         c = backend.config
         n_rows = spec.n_slots * self.n_roles
         itemsize = jnp.dtype(backend.params["embed"].dtype).itemsize
+        # Trunk once per role + per-(slot x role) tails — the prefix is
+        # SHARED, never replicated per slot (models/stepper.py).  Per-chip
+        # bytes (caches shard with the weights under tensor parallelism).
+        # Trunk sessions (n_slots=1) reserve 2x: every tree expansion and
+        # rollout materializes one transient trunk+tail scratch copy
+        # (stepper._scratch_cache).
         cache_bytes = (
-            2 * c.n_layers * n_rows * (self._w0 + spec.max_steps)
+            2 * c.n_layers
+            * (self.n_roles * self._w0 + n_rows * spec.max_steps)
             * c.n_kv_heads * c.head_dim * itemsize
-        )
-        if cache_bytes > _SESSION_CACHE_BYTES_CAP:
+        ) // backend._shard_count
+        if spec.n_slots == 1:
+            cache_bytes *= 2
+        # Compare against the backend's LIVE budget (HBM minus weights and
+        # activation reserve) — a session bigger than the whole budget would
+        # otherwise block in acquire() forever.
+        if cache_bytes > backend._session_budget.cap:
             from consensus_tpu.backends.session import FusedSessionUnavailable
 
             logger.warning(
                 "fused session unavailable: %d-row x %d-wide cache "
-                "(~%.1f GB) over cap", n_rows, self._w0 + spec.max_steps,
-                cache_bytes / 1e9,
+                "(~%.1f GB) over the %.1f GB session budget",
+                n_rows, self._w0 + spec.max_steps, cache_bytes / 1e9,
+                backend._session_budget.cap / 1e9,
             )
             raise FusedSessionUnavailable(
                 f"{n_rows}-row x {self._w0 + spec.max_steps}-wide session "
-                f"cache (~{cache_bytes / 1e9:.1f} GB) over cap"
+                f"cache (~{cache_bytes / 1e9:.1f} GB) over budget"
             )
         # Reserve HBM for the lifetime of the session (blocks while other
         # threads' sessions hold the budget); close() releases it.  The
@@ -646,8 +684,7 @@ class TPUTokenSearchSession:
         backend._session_budget.acquire(cache_bytes)
         self._budget_bytes = cache_bytes
         self._step = 0
-        self._cache = None
-        self._cur_pos = None
+        self._state = None
         bias = backend._bias_vector(spec.bias_against_tokens, spec.bias_value)
         self._ref_bias = jnp.asarray(bias) if bias is not None else None
         # One base key per session; per-(step, slot) keys fold in-device so a
@@ -705,12 +742,10 @@ class TPUTokenSearchSession:
                 np.asarray([c.token_id for c in chosen], np.int32),
             ]
         )
-        step_meta = np.asarray(
-            [self._step, self._w0 + self._step - 1], np.int32
-        )
+        step_meta = np.asarray([self._step, self._step - 1], np.int32)
         out = search_step(
             self.backend.params, self.backend.config,
-            self._cache, self._cur_pos,
+            self._state,
             jnp.asarray(advance), jnp.asarray(step_meta),
             spec.n_slots, self.n_roles,
             self._base_key, self._temperature,
@@ -732,7 +767,7 @@ class TPUTokenSearchSession:
         spec = self.spec
         if spec.n_slots != 1:
             raise ValueError("propose_suffixes requires an n_slots=1 session")
-        if self._cache is None:
+        if self._state is None:
             raise ValueError("call propose() before propose_suffixes()")
         if not suffixes:
             return []
@@ -750,7 +785,7 @@ class TPUTokenSearchSession:
         packed = np.asarray(
             suffix_propose(
                 self.backend.params, self.backend.config,
-                self._cache, self._cur_pos,
+                self._state, jnp.asarray(self._step, jnp.int32),
                 jnp.asarray(tokens), jnp.asarray(salt, jnp.int32),
                 self.n_roles, self._base_key, self._temperature,
                 spec.k, spec.sample,
@@ -774,16 +809,16 @@ class TPUTokenSearchSession:
         spec = self.spec
         if spec.n_slots != 1:
             raise ValueError("rollout_from requires an n_slots=1 session")
-        if self._cache is None:
+        if self._state is None:
             raise ValueError("call propose() before rollout_from()")
         if not suffix:
             raise ValueError("rollout_from needs a non-empty suffix")
         rows = np.asarray(
             rollout_scored(
                 self.backend.params, self.backend.config,
-                self._cache, self._cur_pos,
+                self._state, jnp.asarray(self._step, jnp.int32),
                 jnp.asarray([c.token_id for c in suffix], jnp.int32),
-                jnp.asarray([salt, self._w0 + self._step], jnp.int32),
+                jnp.asarray(salt, jnp.int32),
                 self.n_roles, len(suffix), depth,
                 self._base_key, self._temperature,
                 jnp.asarray(self.backend.tokenizer.eos_ids, jnp.int32),
@@ -802,8 +837,7 @@ class TPUTokenSearchSession:
         # getattr: the constructor may raise before the reservation exists,
         # and __del__ still runs.
         if getattr(self, "_budget_bytes", 0):
-            self._cache = None
-            self._cur_pos = None
+            self._state = None
             self.backend._session_budget.release(self._budget_bytes)
             self._budget_bytes = 0
 
@@ -817,8 +851,7 @@ class TPUTokenSearchSession:
             raise ValueError("session is closed")
 
     def _finish(self, out) -> List[List["ScoredCandidate"]]:
-        self._cache = out.cache
-        self._cur_pos = out.cur_pos
+        self._state = out.state
         return self._unpack(np.asarray(out.packed))
 
     def _unpack(self, packed: np.ndarray) -> List[List["ScoredCandidate"]]:

@@ -1,24 +1,30 @@
-"""Incremental token-search stepper: per-(beam x role) KV caches on device.
+"""Incremental token-search stepper: shared trunk + per-(slot x role) tails.
 
 The token-level decoders (beam search `src/methods/beam_search.py:408-693`,
 finite lookahead, MCTS) need, at every emitted token, (a) k proposed next
-tokens from the reference policy and (b) each proposal's logprob under every
-agent-conditioned policy.  The reference pays one HTTPS round-trip per
-(beam, attempt) and per (beam, token, agent) — 4 000+ s/statement.  Round 1
-of this framework batched those into two full-prefix forwards per step,
-which is still O(T^2) total FLOPs: every step re-runs the whole prefix.
+tokens from the reference policy and (b) every proposal's logprob under
+every agent-conditioned policy.  The reference pays one HTTPS round-trip
+per (beam, attempt) and per (beam, token, agent) — 4 000+ s/statement.
+Round 1 of this framework batched those into two full-prefix forwards per
+step, which is still O(T^2) total FLOPs: every step re-runs the whole
+prefix.
 
-This module makes each search step ONE fused device program over persistent
-KV caches, O(T) total:
+This module makes each search step ONE fused device program, O(T) total,
+with memory O(prefix + slots x steps) instead of O(slots x prefix):
 
-  rows = beam-major (beam b, role j) layout, role 0 = reference policy,
-         roles 1..A = agent-conditioned policies (same weights, different
-         prompt prefix — the reference's core trick, SURVEY §0).
+  - The PREFIX KV cache (prompt + issue + opinions — the bulk) lives ONCE
+    per role (role 0 = reference policy, roles 1..A = agent policies: same
+    weights, different prompt, the reference's core trick, SURVEY §0) and
+    broadcasts against every search slot inside the attention einsums
+    (transformer.forward_trunk_tail).
+  - Only the <=max_steps-column TAIL of generated tokens is per-(slot x
+    role) state; beam reorders gather megabytes of tail, never gigabytes
+    of replicated prefix.
 
   step(parents, token):
-    1. gather cache rows of surviving parent beams (beams reorder/die),
+    1. gather TAIL rows of surviving parent beams (beams reorder/die),
     2. append the chosen token id to every role-row of its beam,
-    3. forward ONE position for all rows,
+    3. forward ONE position for all rows over [shared trunk | own tail],
     4. ref rows:   (gumbel-)top-k over biased logits -> k proposals/beam,
     5. agent rows: log-softmax gathered at those k proposal ids.
 
@@ -42,21 +48,31 @@ from consensus_tpu.models.transformer import (
     KVCache,
     forward,
     forward_shared_trunk,
+    forward_trunk_tail,
     make_cache,
     project_logits,
 )
 
 
+class SearchState(NamedTuple):
+    """Device-resident search state: one shared trunk, per-row tails."""
+
+    trunk: KVCache  # (L, n_roles, W0, KV, hd) — read-only after prefill
+    tail_k: jax.Array  # (L, n_slots * n_roles, Ts, KV, hd)
+    tail_v: jax.Array
+    tail_positions: jax.Array  # (n_slots * n_roles, Ts) int32
+    cur_pos: jax.Array  # (n_slots * n_roles,) int32 — last written position
+
+
 class StepOutput(NamedTuple):
     packed: jax.Array  # (B, k, 2 + A) f32: [id, ref_logprob, agent_logprobs...]
-    cache: KVCache
-    cur_pos: jax.Array  # (R,) int32 — last written RoPE position per row
+    state: SearchState
 
 
 def _propose_and_score(
     params,
     config: ModelConfig,
-    hidden_last: jax.Array,  # (R, D) final-norm hidden of the last position
+    hidden_last: jax.Array,  # (Rows, D) final-norm hidden of the last position
     n_beams: int,
     n_roles: int,
     base_key: jax.Array,  # (2,) — per-(family, step, slot) keys fold in-device
@@ -66,10 +82,10 @@ def _propose_and_score(
     sample: bool,
     ref_bias: Optional[jax.Array],  # (V,) additive bias for ref rows only
     key_family: int = 0,  # disjoint PRNG stream per call family (trunk=0,
-    # suffix-tree=1): nested folds keep streams collision-free even when a
-    # trunk step index equals a suffix salt.
+    # suffix-tree=1, rollout=2): nested folds keep streams collision-free
+    # even when a trunk step index equals a suffix salt.
 ) -> jax.Array:
-    logits = project_logits(params, config, hidden_last)  # (R, V) f32
+    logits = project_logits(params, config, hidden_last)  # (Rows, V) f32
     per_beam = logits.reshape(n_beams, n_roles, -1)
     ref_logits = per_beam[:, 0, :]  # (B, V)
     if ref_bias is not None:
@@ -110,6 +126,43 @@ def _propose_and_score(
     )
 
 
+def _scratch_cache(
+    state: SearchState, t_filled: jax.Array, extra: int
+) -> Tuple[KVCache, jax.Array]:
+    """Materialize [trunk | tail | extra zero columns] as one KVCache for the
+    n_slots=1 (trunk-session) read paths — tree expansion and rollouts.
+    Tail columns >= ``t_filled`` are masked invalid.  Returns the cache and
+    the column index where new writes should land (W0 + t_filled)."""
+    trunk, tail_k = state.trunk, state.tail_k
+    layers, rows = tail_k.shape[0], tail_k.shape[1]
+    t_tail = tail_k.shape[2]
+    pad_kv = ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0))
+    cache = KVCache(
+        k=jnp.pad(jnp.concatenate([trunk.k, tail_k], axis=2), pad_kv),
+        v=jnp.pad(jnp.concatenate([trunk.v, state.tail_v], axis=2), pad_kv),
+        key_positions=jnp.pad(
+            jnp.concatenate(
+                [trunk.key_positions, state.tail_positions], axis=1
+            ),
+            ((0, 0), (0, extra)),
+        ),
+        key_valid=jnp.pad(
+            jnp.concatenate(
+                [
+                    trunk.key_valid,
+                    jnp.broadcast_to(
+                        jnp.arange(t_tail)[None, :] < t_filled,
+                        (rows, t_tail),
+                    ),
+                ],
+                axis=1,
+            ),
+            ((0, 0), (0, extra)),
+        ),
+    )
+    return cache, trunk.k.shape[2] + t_filled
+
+
 @functools.partial(
     jax.jit, static_argnames=("config", "n_beams", "n_roles", "k", "sample", "max_steps")
 )
@@ -127,50 +180,53 @@ def search_prefill(
     max_steps: int,
     ref_bias: Optional[jax.Array] = None,
 ) -> StepOutput:
-    """Prefill the (ref + agents) prefixes once, tile them across beam
-    slots, and return the root proposals (every slot starts identical)."""
+    """Prefill the (ref + agents) prefixes ONCE into the shared trunk,
+    allocate empty per-(slot x role) tails, and return the root proposals
+    (every slot starts identical)."""
     w0 = prefix_tokens.shape[1]
+    c = config
     positions = left_pad_positions(prefix_valid)
-    cache = make_cache(config, n_roles, w0 + max_steps, params["embed"].dtype)
-    hidden, cache = forward(
-        params, config, prefix_tokens, positions, prefix_valid, cache, 0,
+    trunk = make_cache(config, n_roles, w0, params["embed"].dtype)
+    hidden, trunk = forward(
+        params, config, prefix_tokens, positions, prefix_valid, trunk, 0,
         return_hidden=True,
     )
 
-    # Tile (n_roles) prefill rows to (n_beams * n_roles) beam-major rows.
-    def tile(x):  # (n_roles, ...) -> (B * n_roles, ...)
-        return jnp.tile(x, (n_beams,) + (1,) * (x.ndim - 1))
-
-    cache = KVCache(
-        k=jnp.tile(cache.k, (1, n_beams, 1, 1, 1)),
-        v=jnp.tile(cache.v, (1, n_beams, 1, 1, 1)),
-        key_positions=tile(cache.key_positions),
-        key_valid=tile(cache.key_valid),
+    rows = n_beams * n_roles
+    state = SearchState(
+        trunk=trunk,
+        tail_k=jnp.zeros(
+            (c.n_layers, rows, max_steps, c.n_kv_heads, c.head_dim),
+            params["embed"].dtype,
+        ),
+        tail_v=jnp.zeros(
+            (c.n_layers, rows, max_steps, c.n_kv_heads, c.head_dim),
+            params["embed"].dtype,
+        ),
+        tail_positions=jnp.zeros((rows, max_steps), jnp.int32),
+        cur_pos=jnp.tile(positions[:, -1], (n_beams,)),
     )
-    cur_pos = tile(positions[:, -1])  # (R,)
-    hidden_last = tile(hidden[:, -1, :])  # (R, D)
+    hidden_last = jnp.tile(hidden[:, -1, :], (n_beams, 1))
 
     packed = _propose_and_score(
         params, config, hidden_last, n_beams, n_roles, base_key,
         jnp.asarray(0, jnp.int32), temperature, k, sample, ref_bias,
     )
-    return StepOutput(packed, cache, cur_pos)
+    return StepOutput(packed, state)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("config", "n_beams", "n_roles", "k", "sample"),
-    # Donate the multi-GB cache (and cur_pos) so XLA aliases the buffers
-    # instead of holding old + new caches live across the gather.
-    donate_argnums=(2, 3),
+    # Donate the tail buffers — megabytes, and replaced every step.
+    donate_argnums=(2,),
 )
 def search_step(
     params,
     config: ModelConfig,
-    cache: KVCache,
-    cur_pos: jax.Array,  # (R,) int32
+    state: SearchState,
     advance: jax.Array,  # (2, B) int32: row 0 = parent beam, row 1 = token id
-    step_meta: jax.Array,  # (2,) int32: [step_index (1-based), write_index]
+    step_meta: jax.Array,  # (2,) int32: [step_index (1-based), write_col]
     n_beams: int,
     n_roles: int,
     base_key: jax.Array,  # (2,)
@@ -179,37 +235,40 @@ def search_step(
     sample: bool,
     ref_bias: Optional[jax.Array] = None,
 ) -> StepOutput:
-    """Advance every beam slot from its parent by one token; propose + score."""
+    """Advance every beam slot from its parent by one token; propose + score.
+    Only the per-row TAILS are gathered on beam reorders — the shared trunk
+    is untouched."""
     parents, tokens = advance[0], advance[1]
-    step_index, write_index = step_meta[0], step_meta[1]
+    step_index, write_col = step_meta[0], step_meta[1]
     rows = jnp.arange(n_beams * n_roles)
     parent_rows = parents[rows // n_roles] * n_roles + (rows % n_roles)
 
-    cache = KVCache(
-        k=cache.k[:, parent_rows],
-        v=cache.v[:, parent_rows],
-        key_positions=cache.key_positions[parent_rows],
-        key_valid=cache.key_valid[parent_rows],
-    )
-    cur_pos = cur_pos[parent_rows] + 1  # next RoPE position per row
+    tail_k = state.tail_k[:, parent_rows]
+    tail_v = state.tail_v[:, parent_rows]
+    tail_positions = state.tail_positions[parent_rows]
+    cur_pos = state.cur_pos[parent_rows] + 1
     row_tokens = tokens[rows // n_roles]  # same token for every role of a beam
 
-    # One-position forward for all rows, written at the shared cache column.
-    hidden, cache = forward(
-        params,
-        config,
-        row_tokens[:, None],
-        cur_pos[:, None],
-        jnp.ones((n_beams * n_roles, 1), jnp.bool_),
-        cache,
-        write_index,
-        return_hidden=True,
+    tail_positions = jax.lax.dynamic_update_slice(
+        tail_positions, cur_pos[:, None], (0, write_col)
+    )
+    hidden, tail_k, tail_v = forward_trunk_tail(
+        params, config, row_tokens, cur_pos,
+        state.trunk, tail_k, tail_v, tail_positions, write_col,
+        n_beams, n_roles,
     )
     packed = _propose_and_score(
-        params, config, hidden[:, -1, :], n_beams, n_roles, base_key,
+        params, config, hidden, n_beams, n_roles, base_key,
         step_index, temperature, k, sample, ref_bias,
     )
-    return StepOutput(packed, cache, cur_pos)
+    new_state = SearchState(
+        trunk=state.trunk,
+        tail_k=tail_k,
+        tail_v=tail_v,
+        tail_positions=tail_positions,
+        cur_pos=cur_pos,
+    )
+    return StepOutput(packed, new_state)
 
 
 @functools.partial(
@@ -218,8 +277,8 @@ def search_step(
 def suffix_propose(
     params,
     config: ModelConfig,
-    cache: KVCache,  # trunk cache, n_roles rows (NOT consumed)
-    cur_pos: jax.Array,  # (n_roles,) int32
+    state: SearchState,  # n_slots=1 trunk session (NOT consumed)
+    t_filled: jax.Array,  # () int32 — tail columns already generated
     suffix_tokens: jax.Array,  # (P, L) int32 — one row per frontier path
     salt: jax.Array,  # () int32 — folds into per-path proposal keys
     n_roles: int,
@@ -230,11 +289,15 @@ def suffix_propose(
     ref_bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Propose + score k next tokens for every tree path over the SHARED
-    trunk cache (models/transformer.py:forward_shared_trunk).  Returns the
-    packed (P, k, 2 + A) candidate array; the trunk cache is untouched, so
-    a lookahead tree costs one call per LEVEL and zero cache duplication."""
+    trunk+tail cache (models/transformer.py:forward_shared_trunk).  Returns
+    the packed (P, k, 2 + A) candidate array; the session state is
+    untouched, so a lookahead tree costs one call per LEVEL and zero cache
+    duplication."""
     n_paths = suffix_tokens.shape[0]
-    hidden = forward_shared_trunk(params, config, suffix_tokens, cache, cur_pos)
+    cache, _ = _scratch_cache(state, t_filled, extra=0)
+    hidden = forward_shared_trunk(
+        params, config, suffix_tokens, cache, state.cur_pos
+    )
     return _propose_and_score(
         params, config, hidden.reshape(n_paths * n_roles, -1),
         n_paths, n_roles, base_key, salt, temperature, k, sample, ref_bias,
@@ -249,10 +312,10 @@ def suffix_propose(
 def rollout_scored(
     params,
     config: ModelConfig,
-    cache: KVCache,  # trunk cache, n_roles rows (NOT consumed — copied)
-    cur_pos: jax.Array,  # (n_roles,) int32
+    state: SearchState,  # n_slots=1 trunk session (NOT consumed)
+    t_filled: jax.Array,  # () int32
     suffix_tokens: jax.Array,  # (suffix_len,) int32 — the node's path
-    meta: jax.Array,  # (2,) int32: [salt, write_index]
+    salt: jax.Array,  # () int32
     n_roles: int,
     suffix_len: int,
     depth: int,
@@ -261,23 +324,17 @@ def rollout_scored(
     eos_ids: jax.Array,  # (E,) int32
 ) -> jax.Array:
     """MCTS rollout valued in ONE device call: continue ``depth`` tokens from
-    the reference policy past trunk+suffix, scoring each sampled token under
-    every agent from the same logits.  Returns packed (depth, 2 + A) f32
-    rows [token_id, counted, agent_logprobs...]; ``counted`` is 0 from the
-    first EOS on (matching generate()'s EOS-excluded text).  The trunk cache
-    is copied into a widened scratch, so the session state is untouched.
-    Replaces the reference's rollout + per-agent full-statement scoring
-    (mcts.py:470-651) — the call that its own NameError bug aborts.
-    """
-    salt, write_index = meta[0], meta[1]
-    extra = suffix_len + depth
-    pad = ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0))
-    scratch = KVCache(
-        k=jnp.pad(cache.k, pad),
-        v=jnp.pad(cache.v, pad),
-        key_positions=jnp.pad(cache.key_positions, ((0, 0), (0, extra))),
-        key_valid=jnp.pad(cache.key_valid, ((0, 0), (0, extra))),
+    the reference policy past trunk+tail+suffix, scoring each sampled token
+    under every agent from the same logits.  Returns packed (depth, 2 + A)
+    f32 rows [token_id, counted, agent_logprobs...]; ``counted`` is 0 from
+    the first EOS on (matching generate()'s EOS-excluded text).  The session
+    state is copied into a widened scratch, so it stays untouched.  Replaces
+    the reference's rollout + per-agent full-statement scoring
+    (mcts.py:470-651) — the call that its own NameError bug aborts."""
+    scratch, write_index = _scratch_cache(
+        state, t_filled, extra=suffix_len + depth
     )
+    cur_pos = state.cur_pos
 
     tokens = jnp.tile(suffix_tokens[None, :], (n_roles, 1))
     positions = cur_pos[:, None] + 1 + jnp.arange(suffix_len)[None, :]

@@ -344,6 +344,128 @@ def forward(
     return project_logits(params, c, x), new_cache
 
 
+def forward_trunk_tail(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (Rows,) int32 — one new token per (slot x role) row
+    positions: jax.Array,  # (Rows,) int32 — RoPE position of the new token
+    trunk: KVCache,  # (L, R0, W0, ...) shared read-only prefix, R0 = n_roles
+    tail_k: jax.Array,  # (L, Rows, Ts, KV, hd) per-row generated-token keys
+    tail_v: jax.Array,
+    tail_positions: jax.Array,  # (Rows, Ts) int32
+    write_col: jax.Array,  # () int32 — tail column for this step's token
+    n_slots: int,
+    n_roles: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One-token decode step where every search slot shares ONE trunk cache.
+
+    Beam-search slots all contain the identical prompt prefix — replicating
+    it per (slot x role) row (5+ GB for a wide beam on a 2B model) is pure
+    waste, and gathering those replicas on every beam reorder doubles peak
+    HBM when buffer donation isn't honored (the remote-compile OOM this
+    function exists to fix).  Here the prefix lives ONCE per role and
+    broadcasts against all slots inside the attention einsum; only the
+    <=max_steps-column per-row TAIL (the generated tokens) is slot-local
+    state.  Tail columns <= ``write_col`` are visible (the current token
+    writes there first).
+
+    Returns (final-norm hidden (Rows, D), new tail_k, new tail_v).
+    """
+    c = config
+    h, kv, hd = c.n_heads, c.n_kv_heads, c.head_dim
+    reps = h // kv
+    rows = tokens.shape[0]
+    t_tail = tail_k.shape[2]
+
+    x = params["embed"][tokens]  # (Rows, D)
+    if c.scale_embeddings:
+        x = x * jnp.asarray(c.d_model**0.5, x.dtype)
+
+    qp = positions.reshape(n_slots, n_roles)  # (P, R)
+    # Trunk masks: (P, R, W0) — every valid trunk key precedes the query.
+    trunk_kp = trunk.key_positions[None, :, :]  # (1, R, W0)
+    trunk_mask = jnp.broadcast_to(
+        trunk.key_valid[None], (n_slots,) + trunk.key_valid.shape
+    )
+    # Tail masks: (P, R, Ts) — columns up to and including write_col.
+    tail_cols = jnp.arange(t_tail)
+    tail_fill = (tail_cols <= write_col)[None, None, :]
+    tail_kp = tail_positions.reshape(n_slots, n_roles, t_tail)
+    if c.sliding_window is not None:
+        trunk_local = trunk_mask & (qp[:, :, None] - trunk_kp < c.sliding_window)
+        tail_local = tail_fill & (qp[:, :, None] - tail_kp < c.sliding_window)
+    else:
+        trunk_local = trunk_mask
+        tail_local = jnp.broadcast_to(tail_fill, (n_slots, n_roles, t_tail))
+    tail_mask = jnp.broadcast_to(tail_fill, (n_slots, n_roles, t_tail))
+    local_flags = jnp.asarray(c.local_flags)
+
+    def layer_step(x, scanned):
+        lp, k_trunk, v_trunk, k_tail, v_tail, is_local = scanned
+
+        attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
+        q = (attn_in @ lp["wq"]).reshape(rows, 1, h, hd)
+        k = (attn_in @ lp["wk"]).reshape(rows, 1, kv, hd)
+        v = (attn_in @ lp["wv"]).reshape(rows, 1, kv, hd)
+        q = apply_rope(q, positions[:, None], c.rope_theta)
+        k = apply_rope(k, positions[:, None], c.rope_theta)
+
+        new_k_tail = jax.lax.dynamic_update_slice(
+            k_tail, k, (0, write_col, 0, 0)
+        )
+        new_v_tail = jax.lax.dynamic_update_slice(
+            v_tail, v, (0, write_col, 0, 0)
+        )
+
+        qg = q.reshape(n_slots, n_roles, kv, reps, hd)
+        ktg = new_k_tail.reshape(n_slots, n_roles, t_tail, kv, hd)
+        vtg = new_v_tail.reshape(n_slots, n_roles, t_tail, kv, hd)
+
+        # Trunk attention broadcasts the shared (R, W0) keys over slots.
+        lt = jnp.einsum("prgmd,rtgd->prgmt", qg, k_trunk).astype(jnp.float32)
+        ls = jnp.einsum("prgmd,prtgd->prgmt", qg, ktg).astype(jnp.float32)
+        logits = jnp.concatenate([lt, ls], axis=-1) * c.q_scale
+        logits = _softcap(logits, c.attn_softcap)
+        mask = jnp.concatenate(
+            [
+                jnp.where(is_local, trunk_local, trunk_mask),
+                jnp.where(is_local, tail_local, tail_mask),
+            ],
+            axis=-1,
+        )[:, :, None, None]  # (P, R, 1, 1, W0 + Ts)
+        logits = jnp.where(mask, logits, MASK_FILL)
+        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        w0 = k_trunk.shape[1]
+        attn = jnp.einsum(
+            "prgmt,rtgd->prgmd", weights[..., :w0], v_trunk
+        ) + jnp.einsum(
+            "prgmt,prtgd->prgmd", weights[..., w0:], vtg
+        )
+        attn = attn.reshape(rows, h * hd) @ lp["wo"]
+        if c.use_post_norms:
+            attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
+        x = x + attn
+
+        ffn_in = rms_norm(x, lp["ffn_norm"], c.rms_eps, c.rmsnorm_style)
+        gate = ffn_in @ lp["w_gate"]
+        if c.activation == "geglu":
+            gate = jax.nn.gelu(gate, approximate=True)
+        else:
+            gate = jax.nn.silu(gate)
+        ffn = (gate * (ffn_in @ lp["w_up"])) @ lp["w_down"]
+        if c.use_post_norms:
+            ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
+        return x + ffn, (new_k_tail, new_v_tail)
+
+    x, (new_tail_k, new_tail_v) = jax.lax.scan(
+        layer_step,
+        x,
+        (params["layers"], trunk.k, trunk.v, tail_k, tail_v, local_flags),
+    )
+    x = rms_norm(x, params["final_norm"], c.rms_eps, c.rmsnorm_style)
+    return x, new_tail_k, new_tail_v
+
+
 def forward_shared_trunk(
     params: Params,
     config: ModelConfig,

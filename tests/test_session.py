@@ -49,7 +49,7 @@ def test_factory_prefers_tpu_session(backend):
 
 
 def test_factory_falls_back_over_cache_cap(backend):
-    session = open_token_search(backend, make_spec(n_slots=100_000))
+    session = open_token_search(backend, make_spec(n_slots=10_000_000))
     assert isinstance(session, PrefixTokenSearchSession)
 
 
@@ -181,7 +181,7 @@ def test_batching_backend_delegates_sessions_to_inner(backend):
     session.close()
     # Over-cap spec: the fallback must run over the WRAPPER so its calls
     # keep merging through the batching queue.
-    fallback = open_token_search(batching, make_spec(n_slots=100_000))
+    fallback = open_token_search(batching, make_spec(n_slots=10_000_000))
     assert isinstance(fallback, PrefixTokenSearchSession)
     assert fallback.backend is batching
 
