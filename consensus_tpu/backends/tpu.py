@@ -199,7 +199,7 @@ class TPUBackend:
         self._shard_count = (
             self.mesh_plan.mesh.devices.size if self.mesh_plan else 1
         )
-        params_bytes = sum(
+        self._params_bytes = sum(
             x.size * jnp.dtype(x.dtype).itemsize
             for x in jax.tree_util.tree_leaves(self.params)
         ) // self._shard_count
@@ -207,7 +207,7 @@ class TPUBackend:
             _SESSION_CACHE_BYTES_CAP,
             max(
                 _SESSION_MIN_BUDGET_BYTES,
-                _HBM_BYTES - params_bytes - _ACTIVATION_RESERVE_BYTES,
+                _HBM_BYTES - self._params_bytes - _ACTIVATION_RESERVE_BYTES,
             ),
         )
         self._session_budget = _SessionBudget(budget)
@@ -322,23 +322,68 @@ class TPUBackend:
     def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
         return self._sliced(requests, self._generate_impl)
 
-    def _generate_impl(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
-        self.call_counts["generate"] += len(requests)
+    def _generate_rows_allowed(self, cache_width: int) -> int:
+        """Largest decode batch whose KV cache fits HBM next to the weights.
+        The decode scan's cache carry DOUBLE-buffers under the remote AOT
+        compiler (donation is not honored), so the cache budget is halved."""
+        c = self.config
+        itemsize = jnp.dtype(self.params["embed"].dtype).itemsize
+        per_row = (
+            2 * c.n_layers * cache_width * c.n_kv_heads * c.head_dim * itemsize
+        ) // self._shard_count
+        budget = _HBM_BYTES - self._params_bytes - _ACTIVATION_RESERVE_BYTES
+        allowed = max(1, budget // (2 * per_row))
+        # Round DOWN to a power of two so chunk shapes stay reusable — all
+        # the way to 1: returning a floor of 8 when only 2 rows fit would
+        # reintroduce the OOM this guard exists to prevent.
+        bucket = 1
+        while bucket * 2 <= allowed:
+            bucket *= 2
+        return bucket
+
+    def _generate_impl(
+        self,
+        requests: Sequence[GenerationRequest],
+        token_lists: Optional[List[List[int]]] = None,
+    ) -> List[GenerationResult]:
         if not requests:
             return []
 
-        token_lists = [
-            self.tokenizer.encode(self._render_prompt(r), add_bos=True)
-            for r in requests
-        ]
+        if token_lists is None:
+            token_lists = [
+                self.tokenizer.encode(self._render_prompt(r), add_bos=True)
+                for r in requests
+            ]
+        longest = min(max(len(t) for t in token_lists), self.max_context)
+        width = min(_width_bucket(longest), self.max_context)
+        max_new = _width_bucket(max(r.max_tokens for r in requests), minimum=16)
+        allowed = self._generate_rows_allowed(width + max_new)
+        if len(requests) > allowed:
+            # Long-generation batches re-chunk so the KV cache stays inside
+            # the HBM budget (a 32-row x 2048-column cache double-buffered
+            # is 13 GB — the habermas candidate phase OOM).  Token lists ride
+            # along so chunks don't re-render/re-tokenize their prompts.
+            out: List[GenerationResult] = []
+            for i in range(0, len(requests), allowed):
+                out.extend(
+                    self._generate_impl(
+                        requests[i : i + allowed],
+                        token_lists[i : i + allowed],
+                    )
+                )
+            return out
+
+        self.call_counts["generate"] += len(requests)
         # Row bucketing: pad the batch to a power-of-two row count so XLA
         # compiles a small, reused set of programs (decoders hand over
         # varying candidate counts every step).  Dummy rows are all-invalid
-        # and their outputs are never read.
-        pad_rows = _bucket(len(requests), minimum=8) - len(requests)
-        token_lists += [[]] * pad_rows
+        # and their outputs are never read.  The pad floor respects the HBM
+        # row allowance (a floor of 8 with 2 allowed would defeat it).
+        pad_rows = _bucket(
+            len(requests), minimum=min(8, allowed)
+        ) - len(requests)
+        token_lists = list(token_lists) + [[]] * pad_rows
         tokens, valid = self._left_pad_batch(token_lists)
-        max_new = _bucket(max(r.max_tokens for r in requests), minimum=16)
         temperatures = jnp.asarray(
             [r.temperature for r in requests] + [1.0] * pad_rows, jnp.float32
         )
