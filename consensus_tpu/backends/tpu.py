@@ -233,12 +233,17 @@ class TPUBackend:
             )
         return self.tokenizer.raw_prompt(request.user_prompt, request.system_prompt)
 
+    def _batch_width(self, token_lists: List[List[int]]) -> int:
+        """The bucketed width _left_pad_batch will allocate for this batch —
+        shared so HBM allowances are computed from the allocated width."""
+        longest = min(max(len(t) for t in token_lists), self.max_context)
+        return min(_width_bucket(longest), self.max_context)
+
     def _left_pad_batch(
         self, token_lists: List[List[int]]
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """(tokens, valid) left-padded into a shared length bucket."""
-        longest = min(max(len(t) for t in token_lists), self.max_context)
-        width = min(_width_bucket(longest), self.max_context)
+        width = self._batch_width(token_lists)
         pad = self.tokenizer.pad_id
         tokens = np.full((len(token_lists), width), pad, np.int32)
         valid = np.zeros((len(token_lists), width), bool)
@@ -322,17 +327,24 @@ class TPUBackend:
     def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
         return self._sliced(requests, self._generate_impl)
 
-    def _generate_rows_allowed(self, cache_width: int) -> int:
+    def _generate_rows_allowed(self, prompt_width: int, max_new: int) -> int:
         """Largest decode batch whose KV cache fits HBM next to the weights.
-        The decode scan's cache carry DOUBLE-buffers under the remote AOT
-        compiler (donation is not honored), so the cache budget is halved."""
+        The prompt trunk is a scan closure constant (single-buffered); only
+        the max_new-column tail rides the scan carry, which the remote AOT
+        compiler DOUBLE-buffers (donation is not honored there)."""
         c = self.config
         itemsize = jnp.dtype(self.params["embed"].dtype).itemsize
-        per_row = (
-            2 * c.n_layers * cache_width * c.n_kv_heads * c.head_dim * itemsize
+        unit = (
+            2 * c.n_layers * c.n_kv_heads * c.head_dim * itemsize
         ) // self._shard_count
-        budget = _HBM_BYTES - self._params_bytes - _ACTIVATION_RESERVE_BYTES
-        allowed = max(1, budget // (2 * per_row))
+        per_row = (prompt_width + 2 * max_new) * unit
+        # Live search sessions hold real HBM reservations from the same
+        # non-weight slice — generate batches must fit BESIDE them.
+        budget = (
+            _HBM_BYTES - self._params_bytes - _ACTIVATION_RESERVE_BYTES
+            - self._session_budget.used
+        )
+        allowed = max(1, budget // per_row)
         # Round DOWN to a power of two so chunk shapes stay reusable — all
         # the way to 1: returning a floor of 8 when only 2 rows fit would
         # reintroduce the OOM this guard exists to prevent.
@@ -354,10 +366,9 @@ class TPUBackend:
                 self.tokenizer.encode(self._render_prompt(r), add_bos=True)
                 for r in requests
             ]
-        longest = min(max(len(t) for t in token_lists), self.max_context)
-        width = min(_width_bucket(longest), self.max_context)
+        width = self._batch_width(token_lists)
         max_new = _width_bucket(max(r.max_tokens for r in requests), minimum=16)
-        allowed = self._generate_rows_allowed(width + max_new)
+        allowed = self._generate_rows_allowed(width, max_new)
         if len(requests) > allowed:
             # Long-generation batches re-chunk so the KV cache stays inside
             # the HBM budget (a 32-row x 2048-column cache double-buffered

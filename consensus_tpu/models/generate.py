@@ -21,7 +21,12 @@ import jax.numpy as jnp
 
 from consensus_tpu.models.config import ModelConfig
 from consensus_tpu.models.sampling import sample_tokens
-from consensus_tpu.models.transformer import forward, make_cache, project_logits
+from consensus_tpu.models.transformer import (
+    forward,
+    forward_trunk_tail,
+    make_cache,
+    project_logits,
+)
 
 
 class GenerateOutput(NamedTuple):
@@ -56,22 +61,35 @@ def generate_tokens(
     pad_id: int = 0,
 ) -> GenerateOutput:
     batch, s_ctx = prompt_tokens.shape
+    c = config
     if eos_ids is None:
         eos_ids = jnp.zeros((0,), jnp.int32)
     if bias_table is not None:
         # Dedup table shipped from host; per-row bias rows gather ON device.
         logit_bias = bias_table[bias_index]
 
-    cache = make_cache(config, batch, s_ctx + max_new_tokens, params["embed"].dtype)
+    # Prefill into a TRUNK cache of exactly the prompt width.  The decode
+    # scan carries only the (B, max_new) TAIL: the trunk is a closure
+    # constant, so the remote AOT compiler's refusal to alias the scan carry
+    # double-buffers megabytes of tail per step instead of gigabytes of
+    # prompt cache (see transformer.forward_trunk_tail).
+    trunk = make_cache(config, batch, s_ctx, params["embed"].dtype)
     positions = left_pad_positions(prompt_valid)
     # Prefill: take hidden states and project ONLY the last position — a full
     # (B, S_ctx, 256k) logits tensor would blow HBM on production vocabs.
-    hidden, cache = forward(
-        params, config, prompt_tokens, positions, prompt_valid, cache, 0,
+    hidden, trunk = forward(
+        params, config, prompt_tokens, positions, prompt_valid, trunk, 0,
         return_hidden=True,
     )
     next_logits = project_logits(params, config, hidden[:, -1, :])
     cur_pos = positions[:, -1]
+    # Tail positions are static per row: column j holds position base+1+j
+    # (done rows write harmless pad tokens there; their outputs are never
+    # emitted, so they need no masking).
+    tail_positions = cur_pos[:, None] + 1 + jnp.arange(max_new_tokens)[None, :]
+    tail_shape = (c.n_layers, batch, max_new_tokens, c.n_kv_heads, c.head_dim)
+    tail_k = jnp.zeros(tail_shape, params["embed"].dtype)
+    tail_v = jnp.zeros(tail_shape, params["embed"].dtype)
 
     def is_eos(token: jax.Array) -> jax.Array:
         if eos_ids.shape[0] == 0:
@@ -79,7 +97,7 @@ def generate_tokens(
         return jnp.any(token[:, None] == eos_ids[None, :], axis=-1)
 
     def step(carry, i):
-        next_logits, cache, done, key, cur_pos = carry
+        next_logits, tail_k, tail_v, done, key, cur_pos = carry
         if key.ndim == 2:  # per-row keys: rows draw independently
             pairs = jax.vmap(jax.random.split)(key)  # (B, 2, 2)
             key, sub = pairs[:, 0], pairs[:, 1]
@@ -95,20 +113,19 @@ def generate_tokens(
         new_done = done | token_is_eos
 
         pos = cur_pos + 1
-        step_valid = ~done  # EOS token itself still enters the cache
-        logits, new_cache = forward(
-            params,
-            config,
-            token[:, None],
-            pos[:, None],
-            step_valid[:, None],
-            cache,
-            s_ctx + i,
+        # n_slots=1, n_roles=batch: every row attends its OWN trunk row.
+        hidden, tail_k, tail_v = forward_trunk_tail(
+            params, config, token, pos, trunk, tail_k, tail_v,
+            tail_positions, i, 1, batch,
         )
-        carry = (logits[:, 0, :], new_cache, new_done, key, pos)
+        logits = project_logits(params, config, hidden)
+        carry = (logits, tail_k, tail_v, new_done, key, pos)
         return carry, (token, emitted)
 
-    init = (next_logits, cache, jnp.zeros((batch,), jnp.bool_), key, cur_pos)
+    init = (
+        next_logits, tail_k, tail_v,
+        jnp.zeros((batch,), jnp.bool_), key, cur_pos,
+    )
     _, (tokens, emitted) = jax.lax.scan(init=init, f=step, xs=jnp.arange(max_new_tokens))
 
     tokens = tokens.T  # (B, T)
