@@ -228,3 +228,66 @@ class TestDecoderIntegration:
         frame = Experiment(config, backend=backend).run()
         assert len(frame) == 1
         assert frame["error_message"].iloc[0] == ""
+
+
+class TestGenerateChunking:
+    """HBM-aware decode-batch chunking (backends/tpu.py:_generate_rows_allowed)."""
+
+    def make(self, **kw):
+        from consensus_tpu.backends.tpu import TPUBackend
+
+        return TPUBackend(model="tiny-gemma2", dtype="float32", max_context=128, **kw)
+
+    def test_rows_allowed_rounds_down_to_pow2(self, monkeypatch):
+        import consensus_tpu.backends.tpu as tpu_mod
+
+        backend = self.make()
+        unit = (
+            2 * backend.config.n_layers * backend.config.n_kv_heads
+            * backend.config.head_dim * 4  # float32
+        )
+        budget_free = (
+            tpu_mod._HBM_BYTES - backend._params_bytes
+            - tpu_mod._ACTIVATION_RESERVE_BYTES
+        )
+        # Choose width/max_new so exactly 5 rows fit -> pow2 floor is 4.
+        per_row_cols = budget_free // (5 * unit)
+        width = int(per_row_cols) - 2 * 16
+        assert backend._generate_rows_allowed(width, 16) == 4
+
+    def test_rows_allowed_floor_is_one(self, monkeypatch):
+        import consensus_tpu.backends.tpu as tpu_mod
+
+        backend = self.make()
+        monkeypatch.setattr(tpu_mod, "_HBM_BYTES", backend._params_bytes + 1)
+        assert backend._generate_rows_allowed(4096, 512) == 1
+
+    def test_live_sessions_shrink_the_allowance(self):
+        backend = self.make()
+        base = backend._generate_rows_allowed(1024, 128)
+        backend._session_budget.acquire(backend._session_budget.cap // 2)
+        try:
+            assert backend._generate_rows_allowed(1024, 128) <= base
+        finally:
+            backend._session_budget.release(backend._session_budget.cap // 2)
+
+    def test_oversized_batch_chunks_and_results_match(self, monkeypatch):
+        from consensus_tpu.backends.base import GenerationRequest
+        from consensus_tpu.backends.tpu import TPUBackend
+
+        backend = self.make()
+        requests = [
+            GenerationRequest(
+                user_prompt=f"Issue number {i}.", max_tokens=4, seed=100 + i
+            )
+            for i in range(6)
+        ]
+        whole = backend.generate(requests)
+        # Force single-row chunks: per-request results must be identical
+        # (per-row PRNG keys make rows batch-composition independent).
+        monkeypatch.setattr(
+            TPUBackend, "_generate_rows_allowed", lambda self, w, m: 1
+        )
+        chunked = backend.generate(requests)
+        assert [r.text for r in whole] == [r.text for r in chunked]
+        assert backend.call_counts["generate"] == 12  # 6 + 6, not double-counted
