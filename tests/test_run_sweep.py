@@ -1,0 +1,82 @@
+"""End-to-end sweep driver (cli/run_sweep) over a tiny fake-backend tree.
+
+The reference's sweep driver shells out one subprocess per config
+(run_aamas_experiments.py:66-75); ours runs in-process so compiled programs
+are reused — this test pins the glob/filter logic and the full
+per-config pipeline wiring without hardware.
+"""
+
+import pathlib
+
+import pandas as pd
+import yaml
+
+from consensus_tpu.cli.run_sweep import find_config_files, main
+
+
+def write_tree(root: pathlib.Path):
+    scenario = {
+        "issue": "Should the park stay open late?",
+        "agent_opinions": {
+            "Agent 1": "Yes, evenings are the only free time.",
+            "Agent 2": "Noise late at night worries me.",
+        },
+    }
+    for model in ("gemma", "llama"):
+        for s in (1, 2):
+            for method, section in (
+                ("quick_bon", {"best_of_n": {"n": 2, "max_tokens": 8, "seed": 1}}),
+                ("quick_zero", {"zero_shot": {"max_tokens": 8, "seed": 1}}),
+            ):
+                cfg = {
+                    "experiment_name": f"sweeptest_{model}_s{s}_{method}",
+                    "seed": 7,
+                    "num_seeds": 1,
+                    "backend": "fake",
+                    "models": {
+                        "generation_model": "fake",
+                        "evaluation_models": ["fake"],
+                    },
+                    "scenario": scenario,
+                    "methods_to_run": list(section),
+                    "output_dir": str(root / "out"),
+                    **section,
+                }
+                path = root / model / f"scenario_{s}" / f"{method}.yaml"
+                path.parent.mkdir(parents=True, exist_ok=True)
+                path.write_text(yaml.safe_dump(cfg))
+
+
+def test_find_config_files_filters(tmp_path):
+    write_tree(tmp_path)
+    all_configs = find_config_files(str(tmp_path))
+    assert len(all_configs) == 8
+    gemma_only = find_config_files(str(tmp_path), models=["gemma"])
+    assert len(gemma_only) == 4
+    s2_bon = find_config_files(
+        str(tmp_path), scenarios=[2], methods=["quick_bon"]
+    )
+    assert len(s2_bon) == 2
+    assert all("scenario_2" in str(p) and p.stem == "quick_bon" for p in s2_bon)
+
+
+def test_sweep_runs_every_matching_config(tmp_path, monkeypatch):
+    write_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)  # run dirs land under tmp
+    rc = main(
+        [
+            "--configs-root", str(tmp_path),
+            "--model", "gemma",
+            "--method", "quick_bon",
+            "--skip-comparative-ranking",
+            "--quiet",
+        ]
+    )
+    assert rc == 0
+    results = sorted((tmp_path / "out").glob("*/results.csv"))
+    assert len(results) == 2  # gemma x scenario_{1,2} x quick_bon
+    for csv in results:
+        df = pd.read_csv(csv)
+        assert len(df) == 1 and df["error_message"].isna().all()
+        agg = csv.parent / "evaluation" / "improved_aggregate" / "aggregated_metrics.csv"
+        assert agg.exists()
