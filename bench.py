@@ -14,7 +14,8 @@ search (beam 4, 50 tokens), the reference's worst case: 4019-5117
 s/statement on the API.
 
 Weights are random (no checkpoint ships with the repo) — throughput/shapes
-are real, statement text is noise.
+are real, statement text is noise.  Runs the production fast path
+(weight-only int8, models/quant.py) unless BENCH_QUANT=none.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
@@ -50,12 +51,14 @@ def main() -> None:
     from consensus_tpu.backends.tpu import TPUBackend
     from consensus_tpu.methods import get_method_generator
 
+    quantization = os.environ.get("BENCH_QUANT", "int8")  # production fast path
     backend = TPUBackend(
         model=os.environ.get("BENCH_MODEL", "gemma2-2b"),  # tiny-gemma2: CI smoke
         dtype="bfloat16",
         max_context=1024,
         use_flash_attention=True,
         base_seed=0,
+        quantization=None if quantization in ("", "none") else quantization,
     )
     issue = SCENARIO["issue"]
     opinions = dict(SCENARIO["agent_opinions"])
@@ -133,6 +136,7 @@ def main() -> None:
                     ),
                     "bon_seconds_per_statement": round(bon_elapsed / BON_ROUNDS, 2),
                     "weights": "random",
+                    "quantization": backend.quantization or "bf16",
                 },
             }
         )

@@ -135,6 +135,7 @@ class TPUBackend:
         config: Optional[ModelConfig] = None,
         use_flash_attention: bool = False,
         max_batch_rows: int = 64,
+        quantization: Optional[str] = None,
     ):
         self.config = config if config is not None else get_model_config(model)
         if use_flash_attention and not self.config.use_flash_attention:
@@ -176,6 +177,24 @@ class TPUBackend:
             self.params = init_params(
                 self.config, jax.random.PRNGKey(base_seed), jax_dtype
             )
+
+        if quantization not in (None, "none", "int8"):
+            raise ValueError(f"unknown quantization mode: {quantization!r}")
+        if quantization == "int8":
+            # Weight-only int8: halves the HBM bytes every decode step
+            # re-reads (models/quant.py).  Inference-path only — the TP
+            # sharding plan and the train step keep full-precision pytrees.
+            if tp > 1:
+                raise ValueError("quantization=int8 is single-chip (tp=1) only")
+            from consensus_tpu.models.quant import is_quantized, quantize_params
+
+            if not is_quantized(self.params):  # shared params may already be
+                # Donation frees each full-precision leaf as it is consumed —
+                # without it the bf16 set and the int8 copy coexist in HBM.
+                self.params = jax.jit(quantize_params, donate_argnums=0)(
+                    self.params
+                )
+        self.quantization = quantization if quantization != "none" else None
 
         if tp > 1:
             from consensus_tpu.parallel import make_mesh, shard_params

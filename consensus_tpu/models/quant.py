@@ -1,0 +1,172 @@
+"""Weight-only int8 quantization for the inference path.
+
+Decode on a v5e is HBM-bound: every step re-reads the full parameter set,
+so bf16 Gemma-2B (5.2 GB) caps out near 120 steps/s regardless of batch.
+Storing matmul weights as int8 with per-output-channel float32 scales
+halves the bytes the MXU pulls per step; XLA fuses the int8->bf16 convert
+into the dot's operand read, so no dequantized copy ever hits HBM.
+
+The reference has no counterpart (every forward is an HTTPS call,
+src/utils.py:70); this is TPU-native capacity work in the spirit of its
+``api_rate_limit`` knob — more statements per second from the same box.
+
+Scheme: symmetric absmax per output channel.  For a (d_in, d_out) matmul
+weight the contraction axis is d_in, so scales are (1, d_out); for the
+(V, D) embedding/head matrix both uses (row lookup, head projection
+contracting D) share per-vocab-row scales (V, 1).  Values are clipped to
+[-127, 127] (not -128) to keep the grid symmetric.
+
+Norm vectors stay in the compute dtype — they are KB-sized and their
+precision matters more than their bandwidth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+QUANTIZED_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """An int8 weight + float32 per-channel scales, posing as one array.
+
+    ``dtype``/``shape`` report the *logical* (compute dtype, unquantized)
+    view so shape- and dtype-driven call sites (cache allocation,
+    HBM accounting via ``tree_leaves``) keep working unchanged.
+    """
+
+    q: jax.Array  # int8, original weight shape
+    scale: jax.Array  # float32, contraction axis squeezed to 1
+    compute_dtype: Any  # aux: dtype the dequantized weight participates as
+
+    def tree_flatten(self):
+        return (self.q, self.scale), jnp.dtype(self.compute_dtype).name
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scale = children
+        return cls(q=q, scale=scale, compute_dtype=jnp.dtype(aux))
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
+def quantize(w: jax.Array, contract_axis: int) -> QTensor:
+    """Symmetric absmax int8 quantization with scales per output channel
+    (every axis except ``contract_axis`` keeps its extent; the contraction
+    axis is reduced with keepdims so the scale broadcasts back)."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale, compute_dtype=w.dtype)
+
+
+def dequantize(w: QTensor) -> jax.Array:
+    return (w.q.astype(jnp.float32) * w.scale).astype(w.compute_dtype)
+
+
+def matmul(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` where ``w`` is a plain array or a QTensor slice.
+
+    The int8 operand converts to ``x.dtype`` inside the fused dot (HBM reads
+    stay int8); scales apply to the f32 product and the result returns in
+    ``x.dtype``.  For a scanned layer slice ``w.q`` is (d_in, d_out) and
+    ``w.scale`` (1, d_out), broadcasting over rows.
+    """
+    if isinstance(w, QTensor):
+        if w.scale.shape[-2] != 1:
+            # Per-row-scaled (V, 1) tables (embed/lm_head) must go through
+            # take_rows/slice_rows/project_logits — broadcasting their
+            # scales over output columns would be silently wrong.
+            raise ValueError(
+                f"matmul expects per-output-channel scales (..., 1, d_out); "
+                f"got scale shape {w.scale.shape}"
+            )
+        y = jnp.matmul(x, w.q.astype(x.dtype)).astype(jnp.float32)
+        return (y * w.scale.reshape((1,) * (y.ndim - 1) + (-1,))).astype(x.dtype)
+    return x @ w
+
+
+def take_rows(w, idx: jax.Array) -> jax.Array:
+    """Row gather (embedding lookup) for plain or quantized (V, D) tables."""
+    if isinstance(w, QTensor):
+        rows = w.q[idx].astype(jnp.float32) * w.scale[idx]
+        return rows.astype(w.compute_dtype)
+    return w[idx]
+
+
+def slice_rows(w, start: jax.Array, size: int):
+    """Dynamic row-slice of a (V, D) table.  Returns (rows, scales-or-None)
+    with ``rows`` in the compute dtype for plain tables and int8 (plus the
+    (size, 1) f32 scales) for quantized ones, so the streamed scorer can
+    keep the convert inside its tile einsum."""
+    if isinstance(w, QTensor):
+        rows = jax.lax.dynamic_slice(w.q, (start, jnp.int32(0)), (size, w.q.shape[1]))
+        scales = jax.lax.dynamic_slice(w.scale, (start, jnp.int32(0)), (size, 1))
+        return rows, scales
+    return jax.lax.dynamic_slice(w, (start, jnp.int32(0)), (size, w.shape[1])), None
+
+
+def head_matmul(hidden: jax.Array, head) -> jax.Array:
+    """``hidden @ head.T`` for a plain or quantized (V, D) head matrix —
+    float32 logits (..., V).  The int8 operand converts inside the fused
+    einsum; per-vocab-row scales apply to the f32 product."""
+    if isinstance(head, QTensor):
+        return jnp.einsum(
+            "...d,vd->...v",
+            hidden,
+            head.q.astype(hidden.dtype),
+            preferred_element_type=jnp.float32,
+        ) * head.scale[:, 0]
+    return jnp.einsum("...d,vd->...v", hidden, head, preferred_element_type=jnp.float32)
+
+
+def gather_target_logits(x: jax.Array, head, tokens: jax.Array) -> jax.Array:
+    """Per-position dot of hidden states (B, S, D) with the head rows of
+    ``tokens`` (B, S) — float32 (B, S).  Mirrors :func:`head_matmul`'s
+    rounding exactly (int8 rows cast into the dot, f32 scales on the f32
+    product) so a streamed-logsumexp caller's target logit and its tile
+    contribution agree bit-for-bit."""
+    if isinstance(head, QTensor):
+        rows = head.q[tokens, :].astype(x.dtype)  # (B, S, D)
+        return jnp.einsum(
+            "bsd,bsd->bs", x, rows, preferred_element_type=jnp.float32
+        ) * head.scale[tokens, 0]
+    return jnp.einsum(
+        "bsd,bsd->bs", x, head[tokens, :], preferred_element_type=jnp.float32
+    )
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize every large matmul weight of a transformer param pytree.
+
+    Layer weights are stacked (n_layers, d_in, d_out): contraction axis -2,
+    scales (n_layers, 1, d_out) — both leaves keep the leading layer axis so
+    ``lax.scan`` over the stacked pytree slices them together.  The (V, D)
+    embedding and untied lm_head quantize over D (axis -1) for per-row
+    scales shared by the lookup and head-projection uses.
+    """
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key in QUANTIZED_LAYER_KEYS:
+        layers[key] = quantize(layers[key], contract_axis=-2)
+    out["layers"] = layers
+    out["embed"] = quantize(params["embed"], contract_axis=-1)
+    if "lm_head" in params:
+        out["lm_head"] = quantize(params["lm_head"], contract_axis=-1)
+    return out
+
+
+def is_quantized(params: Dict[str, Any]) -> bool:
+    return isinstance(params.get("embed"), QTensor)

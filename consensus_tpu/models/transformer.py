@@ -29,6 +29,13 @@ import jax
 import jax.numpy as jnp
 
 from consensus_tpu.models.config import ModelConfig
+from consensus_tpu.models.quant import (
+    gather_target_logits,
+    head_matmul,
+    matmul,
+    slice_rows,
+    take_rows,
+)
 
 Params = Dict[str, Any]
 
@@ -68,10 +75,17 @@ def init_params(
         "w_down": dense(keys[6], c.n_layers, c.ffn_hidden, c.d_model),
     }
     if c.use_post_norms:
-        zeros = jnp.zeros((c.n_layers, c.d_model), dtype)
-        ones = jnp.ones((c.n_layers, c.d_model), dtype)
-        layers["post_attn_norm"] = zeros if c.rmsnorm_style == "gemma" else ones
-        layers["post_ffn_norm"] = zeros if c.rmsnorm_style == "gemma" else ones
+        # Distinct buffers per leaf — aliased leaves break donation
+        # (e.g. the quantization jit donates the whole pytree).
+        def norm_init():
+            return (
+                jnp.zeros((c.n_layers, c.d_model), dtype)
+                if c.rmsnorm_style == "gemma"
+                else jnp.ones((c.n_layers, c.d_model), dtype)
+            )
+
+        layers["post_attn_norm"] = norm_init()
+        layers["post_ffn_norm"] = norm_init()
 
     params: Params = {
         "embed": (jax.random.normal(keys[7], (c.vocab_size, c.d_model)) * 0.02).astype(
@@ -208,7 +222,7 @@ def forward(
     materialize a full (B, S, V) logits tensor for 256k-vocab models.
     """
     c = config
-    x = params["embed"][tokens]
+    x = take_rows(params["embed"], tokens)
     if c.scale_embeddings:
         x = x * jnp.asarray(c.d_model**0.5, x.dtype)
 
@@ -231,9 +245,9 @@ def forward(
         lp, k_cache_l, v_cache_l, is_local = scanned
 
         attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
-        q = (attn_in @ lp["wq"]).reshape(batch, span, h, hd)
-        k = (attn_in @ lp["wk"]).reshape(batch, span, kv, hd)
-        v = (attn_in @ lp["wv"]).reshape(batch, span, kv, hd)
+        q = matmul(attn_in, lp["wq"]).reshape(batch, span, h, hd)
+        k = matmul(attn_in, lp["wk"]).reshape(batch, span, kv, hd)
+        v = matmul(attn_in, lp["wv"]).reshape(batch, span, kv, hd)
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
 
@@ -297,18 +311,18 @@ def forward(
             logits = jnp.where(mask[:, :, None], logits, MASK_FILL)
             weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
             attn = jnp.einsum("bgrst,btgd->bsgrd", weights, values)
-        attn = attn.reshape(batch, span, h * hd) @ lp["wo"]
+        attn = matmul(attn.reshape(batch, span, h * hd), lp["wo"])
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
         x = x + attn
 
         ffn_in = rms_norm(x, lp["ffn_norm"], c.rms_eps, c.rmsnorm_style)
-        gate = ffn_in @ lp["w_gate"]
+        gate = matmul(ffn_in, lp["w_gate"])
         if c.activation == "geglu":
             gate = jax.nn.gelu(gate, approximate=True)
         else:
             gate = jax.nn.silu(gate)
-        ffn = (gate * (ffn_in @ lp["w_up"])) @ lp["w_down"]
+        ffn = matmul(gate * matmul(ffn_in, lp["w_up"]), lp["w_down"])
         if c.use_post_norms:
             ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
         x = x + ffn
@@ -377,7 +391,7 @@ def forward_trunk_tail(
     rows = tokens.shape[0]
     t_tail = tail_k.shape[2]
 
-    x = params["embed"][tokens]  # (Rows, D)
+    x = take_rows(params["embed"], tokens)  # (Rows, D)
     if c.scale_embeddings:
         x = x * jnp.asarray(c.d_model**0.5, x.dtype)
 
@@ -404,9 +418,9 @@ def forward_trunk_tail(
         lp, k_trunk, v_trunk, k_tail, v_tail, is_local = scanned
 
         attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
-        q = (attn_in @ lp["wq"]).reshape(rows, 1, h, hd)
-        k = (attn_in @ lp["wk"]).reshape(rows, 1, kv, hd)
-        v = (attn_in @ lp["wv"]).reshape(rows, 1, kv, hd)
+        q = matmul(attn_in, lp["wq"]).reshape(rows, 1, h, hd)
+        k = matmul(attn_in, lp["wk"]).reshape(rows, 1, kv, hd)
+        v = matmul(attn_in, lp["wv"]).reshape(rows, 1, kv, hd)
         q = apply_rope(q, positions[:, None], c.rope_theta)
         k = apply_rope(k, positions[:, None], c.rope_theta)
 
@@ -441,18 +455,18 @@ def forward_trunk_tail(
         ) + jnp.einsum(
             "prgmt,prtgd->prgmd", weights[..., w0:], vtg
         )
-        attn = attn.reshape(rows, h * hd) @ lp["wo"]
+        attn = matmul(attn.reshape(rows, h * hd), lp["wo"])
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
         x = x + attn
 
         ffn_in = rms_norm(x, lp["ffn_norm"], c.rms_eps, c.rmsnorm_style)
-        gate = ffn_in @ lp["w_gate"]
+        gate = matmul(ffn_in, lp["w_gate"])
         if c.activation == "geglu":
             gate = jax.nn.gelu(gate, approximate=True)
         else:
             gate = jax.nn.silu(gate)
-        ffn = (gate * (ffn_in @ lp["w_up"])) @ lp["w_down"]
+        ffn = matmul(gate * matmul(ffn_in, lp["w_up"]), lp["w_down"])
         if c.use_post_norms:
             ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
         return x + ffn, (new_k_tail, new_v_tail)
@@ -492,7 +506,7 @@ def forward_shared_trunk(
     reps = h // kv
     n_roles = cache.key_valid.shape[0]
 
-    x = params["embed"][suffix_tokens]  # (P, L, D)
+    x = take_rows(params["embed"], suffix_tokens)  # (P, L, D)
     if c.scale_embeddings:
         x = x * jnp.asarray(c.d_model**0.5, x.dtype)
     x = jnp.broadcast_to(x[:, None], (n_paths, n_roles) + x.shape[1:])  # (P,R,L,D)
@@ -527,9 +541,9 @@ def forward_shared_trunk(
 
         attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
         flat = attn_in.reshape(n_paths * n_roles, span, -1)
-        q = (flat @ lp["wq"]).reshape(n_paths * n_roles, span, h, hd)
-        ks = (flat @ lp["wk"]).reshape(n_paths * n_roles, span, kv, hd)
-        vs = (flat @ lp["wv"]).reshape(n_paths * n_roles, span, kv, hd)
+        q = matmul(flat, lp["wq"]).reshape(n_paths * n_roles, span, h, hd)
+        ks = matmul(flat, lp["wk"]).reshape(n_paths * n_roles, span, kv, hd)
+        vs = matmul(flat, lp["wv"]).reshape(n_paths * n_roles, span, kv, hd)
         rope_pos = jnp.tile(positions, (n_paths, 1))  # (P*R, L)
         q = apply_rope(q, rope_pos, c.rope_theta)
         ks = apply_rope(ks, rope_pos, c.rope_theta)
@@ -559,18 +573,18 @@ def forward_shared_trunk(
         ) + jnp.einsum(
             "prgmst,prtgd->prsgmd", weights[..., t_len:], vs
         )
-        attn = attn.reshape(n_paths, n_roles, span, h * hd) @ lp["wo"]
+        attn = matmul(attn.reshape(n_paths, n_roles, span, h * hd), lp["wo"])
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
         x = x + attn
 
         ffn_in = rms_norm(x, lp["ffn_norm"], c.rms_eps, c.rmsnorm_style)
-        gate = ffn_in @ lp["w_gate"]
+        gate = matmul(ffn_in, lp["w_gate"])
         if c.activation == "geglu":
             gate = jax.nn.gelu(gate, approximate=True)
         else:
             gate = jax.nn.silu(gate)
-        ffn = (gate * (ffn_in @ lp["w_up"])) @ lp["w_down"]
+        ffn = matmul(gate * matmul(ffn_in, lp["w_up"]), lp["w_down"])
         if c.use_post_norms:
             ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
         return x + ffn, None
@@ -592,10 +606,7 @@ def project_logits(params: Params, config: ModelConfig, hidden: jax.Array) -> ja
     the model's final softcap.  Callers slice hidden down (e.g. to the last
     position) BEFORE projecting so a (B, S, 256k) tensor never materializes."""
     head = params["embed"] if config.tie_lm_head else params["lm_head"]
-    logits = jnp.einsum(
-        "...d,vd->...v", hidden, head, preferred_element_type=jnp.float32
-    )
-    return _softcap(logits, config.final_softcap)
+    return _softcap(head_matmul(hidden, head), config.final_softcap)
 
 
 @functools.partial(jax.jit, static_argnames=("config",))
@@ -655,12 +666,15 @@ def token_logprobs_streamed(
         # would materialize a full copy of the 256k-row embedding in HBM.
         # Rows a clamped tile re-reads are masked out below.
         start = jnp.maximum(jnp.minimum(i * vocab_chunk, vocab - vocab_chunk), 0)
-        rows = jax.lax.dynamic_slice(
-            head, (start, jnp.int32(0)), (min(vocab_chunk, vocab), head.shape[1])
-        )
+        rows, row_scales = slice_rows(head, start, min(vocab_chunk, vocab))
         tile = jnp.einsum(
-            "bsd,vd->bsv", x, rows, preferred_element_type=jnp.float32
+            "bsd,vd->bsv",
+            x,
+            rows.astype(x.dtype) if row_scales is not None else rows,
+            preferred_element_type=jnp.float32,
         )
+        if row_scales is not None:
+            tile = tile * row_scales[:, 0][None, None, :]
         tile = _softcap(tile, c.final_softcap)
         row_ids = start + jnp.arange(rows.shape[0])
         fresh = (row_ids >= i * vocab_chunk) & (row_ids < vocab)
@@ -679,11 +693,10 @@ def token_logprobs_streamed(
     (run_max, run_sum), _ = jax.lax.scan(tile_step, init, jnp.arange(n_chunks))
     lse = run_max + jnp.log(run_sum)  # (B, S)
 
-    # Target logits: gather the next token's head row, dot with hidden.
-    target_rows = head[tokens[:, 1:], :]  # (B, S-1, D)
-    target_logits = jnp.einsum(
-        "bsd,bsd->bs", x[:, :-1, :], target_rows, preferred_element_type=jnp.float32
-    )
+    # Target logits: gather the next token's head row, dot with hidden —
+    # gather_target_logits mirrors the tile einsum's rounding exactly, so
+    # the target logit never exceeds its own LSE contribution.
+    target_logits = gather_target_logits(x[:, :-1, :], head, tokens[:, 1:])
     target_logits = _softcap(target_logits, c.final_softcap)
     gathered = target_logits - lse[:, :-1]
     return jnp.pad(gathered, ((0, 0), (1, 0)))

@@ -1,0 +1,192 @@
+"""Int8 weight-only quantization (models/quant.py) correctness tests.
+
+The quantized path must (a) bound per-weight error by construction,
+(b) track the full-precision model's logprobs closely on every scoring
+primitive (dense, streamed, tied and untied heads), and (c) drop into
+TPUBackend as a config switch without changing any protocol semantics.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensus_tpu.backends.base import GenerationRequest, ScoreRequest
+from consensus_tpu.backends.tpu import TPUBackend
+from consensus_tpu.models import transformer as T
+from consensus_tpu.models.config import get_model_config
+from consensus_tpu.models.generate import generate_tokens, next_token_topk
+from consensus_tpu.models.quant import (
+    QTensor,
+    dequantize,
+    is_quantized,
+    quantize,
+    quantize_params,
+)
+
+
+def _tiny(name="tiny-gemma2", dtype=jnp.float32):
+    cfg = get_model_config(name)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    return cfg, params
+
+
+def _batch(cfg, b=4, s=24, seed=1):
+    toks = jax.random.randint(jax.random.PRNGKey(seed), (b, s), 1, cfg.vocab_size)
+    return toks, jnp.ones((b, s), bool)
+
+
+class TestQTensor:
+    def test_roundtrip_error_bounded_by_half_step(self):
+        w = jax.random.normal(jax.random.PRNGKey(3), (16, 32)) * 0.1
+        qt = quantize(w, contract_axis=-2)
+        assert qt.q.dtype == jnp.int8
+        err = jnp.abs(dequantize(qt) - w)
+        # Symmetric absmax: |w - deq| <= scale/2 per output channel.
+        assert bool(jnp.all(err <= qt.scale[0] / 2 + 1e-7))
+
+    def test_scale_shapes_follow_contraction_axis(self):
+        stacked = jax.random.normal(jax.random.PRNGKey(4), (3, 8, 16))
+        assert quantize(stacked, contract_axis=-2).scale.shape == (3, 1, 16)
+        table = jax.random.normal(jax.random.PRNGKey(5), (64, 8))
+        assert quantize(table, contract_axis=-1).scale.shape == (64, 1)
+
+    def test_zero_channel_quantizes_to_zero(self):
+        w = jnp.zeros((4, 4))
+        qt = quantize(w, contract_axis=-2)
+        assert bool(jnp.all(dequantize(qt) == 0.0))
+
+    def test_pytree_roundtrip_preserves_compute_dtype(self):
+        qt = quantize(jnp.ones((4, 4), jnp.bfloat16), contract_axis=-2)
+        leaves, treedef = jax.tree_util.tree_flatten(qt)
+        rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert rebuilt.dtype == jnp.bfloat16
+        assert rebuilt.shape == (4, 4)
+
+
+class TestQuantizedForward:
+    def test_quantize_params_structure(self):
+        cfg, params = _tiny()
+        qp = quantize_params(params)
+        assert is_quantized(qp) and not is_quantized(params)
+        assert isinstance(qp["layers"]["wq"], QTensor)
+        # Norms stay full precision.
+        assert not isinstance(qp["layers"]["attn_norm"], QTensor)
+        assert not isinstance(qp["final_norm"], QTensor)
+
+    def test_token_logprobs_close_to_full_precision(self):
+        cfg, params = _tiny()
+        toks, valid = _batch(cfg)
+        full = np.asarray(T.token_logprobs(params, cfg, toks, valid))
+        quant = np.asarray(T.token_logprobs(quantize_params(params), cfg, toks, valid))
+        assert np.max(np.abs(full - quant)) < 0.1
+        assert np.mean(np.abs(full - quant)) < 0.02
+
+    def test_streamed_matches_dense_under_quantization(self):
+        cfg, params = _tiny()
+        qp = quantize_params(params)
+        toks, valid = _batch(cfg, seed=2)
+        dense = np.asarray(T.token_logprobs(qp, cfg, toks, valid))
+        streamed = np.asarray(
+            T.token_logprobs_streamed(qp, cfg, toks, valid, vocab_chunk=64)
+        )
+        np.testing.assert_allclose(streamed, dense, atol=5e-3)
+
+    def test_matmul_rejects_per_row_scaled_tables(self):
+        table = quantize(jax.random.normal(jax.random.PRNGKey(6), (64, 8)), -1)
+        with pytest.raises(ValueError, match="per-output-channel"):
+            from consensus_tpu.models.quant import matmul
+
+            matmul(jnp.ones((2, 64)), table)
+
+    def test_streamed_logprobs_nonpositive_in_bfloat16(self):
+        """The target-row path must round exactly like the LSE tile path —
+        a mismatch shows up as logprobs above zero (code-review finding)."""
+        cfg, params = _tiny(dtype=jnp.bfloat16)
+        qp = quantize_params(params)
+        toks, valid = _batch(cfg, b=8, s=32, seed=7)
+        lp = np.asarray(
+            T.token_logprobs_streamed(qp, cfg, toks, valid, vocab_chunk=64)
+        )
+        assert np.max(lp) <= 1e-5
+
+    def test_untied_lm_head_quantizes(self):
+        cfg, params = _tiny("tiny-llama3")
+        assert "lm_head" in params
+        qp = quantize_params(params)
+        assert isinstance(qp["lm_head"], QTensor)
+        toks, valid = _batch(cfg, seed=3)
+        full = np.asarray(T.token_logprobs(params, cfg, toks, valid))
+        quant = np.asarray(T.token_logprobs(qp, cfg, toks, valid))
+        assert np.max(np.abs(full - quant)) < 0.1
+
+    def test_topk_agrees_with_full_precision(self):
+        cfg, params = _tiny()
+        qp = quantize_params(params)
+        toks, valid = _batch(cfg, b=8, seed=4)
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(8))
+        temp, det = jnp.ones(8), jnp.zeros(8, bool)
+        ids_f, _ = next_token_topk(
+            params, cfg, toks, valid, keys, 5, temp, det, with_gumbel=False
+        )
+        ids_q, _ = next_token_topk(
+            qp, cfg, toks, valid, keys, 5, temp, det, with_gumbel=False
+        )
+        top1_agree = np.mean(np.asarray(ids_f)[:, 0] == np.asarray(ids_q)[:, 0])
+        assert top1_agree >= 0.75
+
+    def test_generate_runs_and_is_deterministic(self):
+        cfg, params = _tiny()
+        qp = quantize_params(params)
+        toks, valid = _batch(cfg, b=2, s=12, seed=5)
+        out1 = generate_tokens(
+            qp, cfg, toks, valid, jax.random.PRNGKey(9), max_new_tokens=6
+        )
+        out2 = generate_tokens(
+            qp, cfg, toks, valid, jax.random.PRNGKey(9), max_new_tokens=6
+        )
+        np.testing.assert_array_equal(np.asarray(out1.tokens), np.asarray(out2.tokens))
+        assert bool(jnp.all(out1.tokens < cfg.vocab_size))
+
+
+class TestBackendIntegration:
+    @pytest.fixture(scope="class")
+    def backends(self):
+        kw = dict(model="tiny-gemma2", dtype="float32", max_context=128, base_seed=0)
+        return TPUBackend(**kw), TPUBackend(quantization="int8", **kw)
+
+    def test_scores_track_full_precision(self, backends):
+        full, quant = backends
+        reqs = [
+            ScoreRequest(context=f"Context {i} about the issue.", continuation="A fair statement.")
+            for i in range(3)
+        ]
+        lp_f = [r.mean() for r in full.score(reqs)]
+        lp_q = [r.mean() for r in quant.score(reqs)]
+        np.testing.assert_allclose(lp_q, lp_f, atol=0.1)
+
+    def test_generate_protocol_intact(self, backends):
+        _, quant = backends
+        results = quant.generate(
+            [GenerationRequest(user_prompt="Hello", max_tokens=6, seed=1)]
+        )
+        assert results[0].finish_reason in ("stop", "length")
+        again = quant.generate(
+            [GenerationRequest(user_prompt="Hello", max_tokens=6, seed=1)]
+        )
+        assert results[0].text == again[0].text
+
+    def test_params_bytes_halved(self, backends):
+        full, quant = backends
+        # int8 weights + f32 scales: comfortably under 60% of f32 bytes
+        # for the tiny model (and ~50% of bf16 for production models).
+        assert quant._params_bytes < 0.6 * full._params_bytes
+
+    def test_tp_with_quantization_rejected(self):
+        with pytest.raises(ValueError, match="single-chip"):
+            TPUBackend(model="tiny-gemma2", tp=2, quantization="int8")
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="quantization"):
+            TPUBackend(model="tiny-gemma2", quantization="int4")
