@@ -161,39 +161,65 @@ class TPUBackend:
         # ceil(B / max_batch_rows) jitted slices and concatenates.
         self.max_batch_rows = max(1, max_batch_rows)
 
+        if quantization not in (None, "none", "int8"):
+            raise ValueError(f"unknown quantization mode: {quantization!r}")
+        if quantization == "int8" and tp > 1:
+            # Inference-path only — the TP sharding plan and the train step
+            # keep full-precision pytrees.
+            raise ValueError("quantization=int8 is single-chip (tp=1) only")
+        want_int8 = quantization == "int8" and params is None
+
         jax_dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype]
+        # Weight-only int8 (models/quant.py) halves the HBM bytes every
+        # decode step re-reads — and for gemma2-9b/llama3-8b it is the only
+        # way onto one 16 GB v5e at all (their bf16 trees alone exceed HBM).
+        # So the full-precision tree must NEVER land on the accelerator:
+        # init/load on the host CPU backend, quantize there (threefry is
+        # platform-deterministic, so host init == device init), and ship
+        # only the int8+scale leaves across.
+        import contextlib
+
+        host = (
+            jax.default_device(jax.local_devices(backend="cpu")[0])
+            if want_int8
+            else contextlib.nullcontext()
+        )
         if params is not None:
             self.params = params
         elif checkpoint:
             from consensus_tpu.models.loader import load_params
 
-            self.params = load_params(checkpoint, self.config, jax_dtype)
+            with host:
+                self.params = load_params(checkpoint, self.config, jax_dtype)
         else:
             logger.warning(
                 "TPUBackend: no checkpoint given — using RANDOM weights (%s). "
                 "Statements will be noise; timings/shapes are real.",
                 self.config.name,
             )
-            self.params = init_params(
-                self.config, jax.random.PRNGKey(base_seed), jax_dtype
-            )
+            with host:
+                self.params = init_params(
+                    self.config, jax.random.PRNGKey(base_seed), jax_dtype
+                )
 
-        if quantization not in (None, "none", "int8"):
-            raise ValueError(f"unknown quantization mode: {quantization!r}")
         if quantization == "int8":
-            # Weight-only int8: halves the HBM bytes every decode step
-            # re-reads (models/quant.py).  Inference-path only — the TP
-            # sharding plan and the train step keep full-precision pytrees.
-            if tp > 1:
-                raise ValueError("quantization=int8 is single-chip (tp=1) only")
             from consensus_tpu.models.quant import is_quantized, quantize_params
 
             if not is_quantized(self.params):  # shared params may already be
-                # Donation frees each full-precision leaf as it is consumed —
-                # without it the bf16 set and the int8 copy coexist in HBM.
-                self.params = jax.jit(quantize_params, donate_argnums=0)(
-                    self.params
-                )
+                if want_int8:  # host tree: quantize on host, then transfer
+                    with host:
+                        # jit on the host device so XLA fuses the f32 casts
+                        # instead of materializing eager 2x-size temporaries;
+                        # donation frees each full-precision leaf as it is
+                        # consumed (nothing else references the host tree).
+                        quantized = jax.jit(quantize_params, donate_argnums=0)(
+                            self.params
+                        )
+                    self.params = jax.device_put(quantized, jax.devices()[0])
+                else:
+                    # Caller-supplied device tree (assumed to fit): the
+                    # caller may still hold references, so do NOT donate.
+                    self.params = jax.jit(quantize_params)(self.params)
         self.quantization = quantization if quantization != "none" else None
 
         if tp > 1:

@@ -66,9 +66,10 @@ def quantize(w: jax.Array, contract_axis: int) -> QTensor:
     """Symmetric absmax int8 quantization with scales per output channel
     (every axis except ``contract_axis`` keeps its extent; the contraction
     axis is reduced with keepdims so the scale broadcasts back)."""
-    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis, keepdims=True)
+    w32 = w.astype(jnp.float32)  # bind once: eager callers pay one f32 copy
+    absmax = jnp.max(jnp.abs(w32), axis=contract_axis, keepdims=True)
     scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return QTensor(q=q, scale=scale, compute_dtype=w.dtype)
 
 

@@ -183,6 +183,19 @@ class TestBackendIntegration:
         # for the tiny model (and ~50% of bf16 for production models).
         assert quant._params_bytes < 0.6 * full._params_bytes
 
+    def test_caller_supplied_params_not_invalidated(self):
+        """quantization='int8' with a caller-supplied tree must not donate
+        the caller's buffers (code-review finding): the tree may be shared
+        with another backend or still in use."""
+        cfg = get_model_config("tiny-gemma2")
+        params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        TPUBackend(
+            model="tiny-gemma2", dtype="float32", max_context=128,
+            params=params, quantization="int8",
+        )
+        # The caller's full-precision arrays are still alive and readable.
+        assert np.isfinite(np.asarray(params["embed"])).all()
+
     def test_tp_with_quantization_rejected(self):
         with pytest.raises(ValueError, match="single-chip"):
             TPUBackend(model="tiny-gemma2", tp=2, quantization="int8")

@@ -217,6 +217,30 @@ def test_generate_stops_at_eos(params):
     assert np.asarray(out.tokens == 0).all()
 
 
+def test_generate_early_exit_matches_full_run(params):
+    """The decode while_loop exits once every row is done (early-exit path);
+    a batch where all rows EOS immediately must return the same empty output
+    a full-budget run would, for every row."""
+    prompt = _random_tokens(jax.random.PRNGKey(11), 4, 6, CFG.vocab_size)
+    valid = jnp.ones((4, 6), bool)
+    first = generate_tokens(
+        params, CFG, prompt, valid, jax.random.PRNGKey(0), 1, temperature=0.0
+    ).tokens[:, 0]
+    out = generate_tokens(
+        params,
+        CFG,
+        prompt,
+        valid,
+        jax.random.PRNGKey(0),
+        32,
+        temperature=0.0,
+        eos_ids=jnp.unique(first, size=4),
+    )
+    assert np.asarray(out.num_generated == 0).all()
+    assert np.asarray(out.hit_eos).all()
+    assert np.asarray(out.tokens == 0).all()
+
+
 def test_next_token_logits_matches_forward(params):
     tokens = _random_tokens(jax.random.PRNGKey(11), 2, 5, CFG.vocab_size)
     valid = jnp.ones((2, 5), bool)
