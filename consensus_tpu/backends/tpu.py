@@ -163,10 +163,6 @@ class TPUBackend:
 
         if quantization not in (None, "none", "int8"):
             raise ValueError(f"unknown quantization mode: {quantization!r}")
-        if quantization == "int8" and tp > 1:
-            # Inference-path only — the TP sharding plan and the train step
-            # keep full-precision pytrees.
-            raise ValueError("quantization=int8 is single-chip (tp=1) only")
         want_int8 = quantization == "int8" and params is None
 
         jax_dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype]
@@ -203,6 +199,10 @@ class TPUBackend:
                 )
 
         if quantization == "int8":
+            # Weight-only int8 (models/quant.py): halves decode HBM traffic;
+            # composes with tensor parallelism (mesh.py shards q like the
+            # weight and replicates squeezed scale axes).  The train step
+            # keeps full-precision pytrees.
             from consensus_tpu.models.quant import is_quantized, quantize_params
 
             if not is_quantized(self.params):  # shared params may already be
@@ -215,7 +215,10 @@ class TPUBackend:
                         quantized = jax.jit(quantize_params, donate_argnums=0)(
                             self.params
                         )
-                    self.params = jax.device_put(quantized, jax.devices()[0])
+                    if tp > 1:  # shard_params below places the int8 tree
+                        self.params = quantized
+                    else:
+                        self.params = jax.device_put(quantized, jax.devices()[0])
                 else:
                     # Caller-supplied device tree (assumed to fit): the
                     # caller may still hold references, so do NOT donate.

@@ -1,9 +1,10 @@
 """Batched autoregressive generation with a preallocated KV cache.
 
-The decode loop is a ``lax.scan`` over step index — one compiled program per
-(batch, context, max_new_tokens) shape bucket.  Prompts must be LEFT-padded
-so every row's next token writes the same cache slot and the last prompt
-column is always a real token.
+The decode loop is a ``lax.while_loop`` over step index — one compiled
+program per (batch, context, max_new_tokens) shape bucket, exiting as soon
+as every row has hit EOS (each skipped step saves a full weight read).
+Prompts must be LEFT-padded so every row's next token writes the same cache
+slot and the last prompt column is always a real token.
 
 Replaces the reference's per-call HTTPS text generation
 (``generate_text``, src/utils.py:77-198): temperature/seed/stop/logit-bias
@@ -141,9 +142,13 @@ def generate_tokens(
             tokens_buf, emitted_buf,
         )
 
+    # Bucket-padding dummy rows (no valid prompt tokens) start done: their
+    # outputs are never read, but left not-done they would almost never
+    # sample an EOS id and so would pin the early exit at the full budget.
+    init_done = ~jnp.any(prompt_valid, axis=1)
     init = (
         jnp.asarray(0, jnp.int32), next_logits, tail_k, tail_v,
-        jnp.zeros((batch,), jnp.bool_), key, cur_pos, tokens_buf, emitted_buf,
+        init_done, key, cur_pos, tokens_buf, emitted_buf,
     )
     final = jax.lax.while_loop(cond, body, init)
     tokens, emitted = final[7], final[8]

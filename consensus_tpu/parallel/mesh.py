@@ -95,16 +95,45 @@ _TOP_SPECS: Dict[str, P] = {
 }
 
 
+def _leaf_sharding(leaf: Any, spec: P, mesh: Mesh) -> Any:
+    """Sharding for one param leaf — plain array or int8 QTensor.
+
+    A QTensor's ``q`` shards exactly like the full-precision weight.  Its
+    ``scale`` keeps the weight's rank with the contraction axis squeezed to
+    extent 1, so the scale inherits the weight's spec with ``None`` on every
+    size-1 axis (a size-1 axis cannot split over a mesh axis; every shard
+    needs the full scale vector anyway — wo/w_down shard their *input*
+    features, whose scales are per-*output*-channel and must replicate).
+    """
+    from consensus_tpu.models.quant import QTensor
+
+    if isinstance(leaf, QTensor):
+        axes = tuple(spec) + (None,) * (leaf.scale.ndim - len(tuple(spec)))
+        scale_spec = P(
+            *[
+                None if dim == 1 else axis
+                for axis, dim in zip(axes, leaf.scale.shape)
+            ]
+        )
+        return QTensor(
+            q=NamedSharding(mesh, spec),
+            scale=NamedSharding(mesh, scale_spec),
+            compute_dtype=leaf.compute_dtype,
+        )
+    return NamedSharding(mesh, spec)
+
+
 def param_shardings(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
-    """NamedSharding pytree matching a runtime param pytree."""
+    """NamedSharding pytree matching a runtime param pytree (full-precision
+    or int8-quantized leaves)."""
 
     def top(name: str, value):
         if name == "layers":
             return {
-                k: NamedSharding(mesh, _LAYER_SPECS.get(k, P()))
-                for k in value
+                k: _leaf_sharding(v, _LAYER_SPECS.get(k, P()), mesh)
+                for k, v in value.items()
             }
-        return NamedSharding(mesh, _TOP_SPECS.get(name, P()))
+        return _leaf_sharding(value, _TOP_SPECS.get(name, P()), mesh)
 
     return {name: top(name, value) for name, value in params.items()}
 

@@ -196,9 +196,54 @@ class TestBackendIntegration:
         # The caller's full-precision arrays are still alive and readable.
         assert np.isfinite(np.asarray(params["embed"])).all()
 
-    def test_tp_with_quantization_rejected(self):
-        with pytest.raises(ValueError, match="single-chip"):
-            TPUBackend(model="tiny-gemma2", tp=2, quantization="int8")
+    def test_token_search_session_consistent_under_int8(self, backends):
+        """The fused incremental session and the full-prefix oracle must
+        agree on a quantized backend exactly as they do in full precision —
+        the int8 weights flow through forward_trunk_tail/forward_shared_trunk
+        (sessions) and plain forward (oracle) alike."""
+        _, quant = backends
+        from consensus_tpu.backends.session import (
+            PrefixTokenSearchSession,
+            SearchSpec,
+        )
+        from consensus_tpu.backends.tpu import TPUTokenSearchSession
+
+        spec = SearchSpec(
+            ref_system="You draft consensus statements.",
+            ref_user="Issue: parks.\nStatement:",
+            agent_prompts=(("Agent.", "Opinion: more parks.\nStatement:"),),
+            n_slots=2, k=3, temperature=1.0, seed=3, sample=False, max_steps=4,
+        )
+        fused = TPUTokenSearchSession(quant, spec)
+        oracle = PrefixTokenSearchSession(quant, spec)
+        try:
+            fp = fused.propose()
+            op = oracle.propose()
+            for slot in range(spec.n_slots):
+                assert [c.token_id for c in fp[slot]] == [
+                    c.token_id for c in op[slot]
+                ]
+                np.testing.assert_allclose(
+                    [c.ref_logprob for c in fp[slot]],
+                    [c.ref_logprob for c in op[slot]],
+                    atol=5e-4,
+                )
+        finally:
+            fused.close()
+            oracle.close()
+
+    def test_tp_mesh_matches_single_device_under_int8(self):
+        """An int8 tree shards over the (data, model) mesh like the
+        full-precision one: q slices like the weight, scales replicate on
+        their squeezed contraction axis.  Generation must be identical."""
+        from consensus_tpu.backends.base import GenerationRequest
+
+        kw = dict(model="tiny-gemma2", dtype="float32", max_context=128,
+                  base_seed=0, quantization="int8")
+        single = TPUBackend(**kw)
+        sharded = TPUBackend(tp=2, **kw)
+        reqs = [GenerationRequest(user_prompt="Shard me", max_tokens=6, seed=3)]
+        assert single.generate(reqs)[0].text == sharded.generate(reqs)[0].text
 
     def test_unknown_mode_rejected(self):
         with pytest.raises(ValueError, match="quantization"):
