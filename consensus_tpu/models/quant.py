@@ -77,6 +77,26 @@ def dequantize(w: QTensor) -> jax.Array:
     return (w.q.astype(jnp.float32) * w.scale).astype(w.compute_dtype)
 
 
+#: How the int8 operand enters the dot.  "mixed" hands the s8 array to
+#: ``lax.dot_general`` directly (int8 values are exact in bf16, so both
+#: lowerings compute the same product); "astype" inserts an explicit
+#: convert for XLA to fuse.  Toggle for A/B profiling on hardware.
+MATMUL_LOWERING = "astype"
+
+
+def _qdot(x: jax.Array, q: jax.Array, dim: int) -> jax.Array:
+    """f32-accumulated ``x . q`` contracting x's last axis with q's ``dim``."""
+    if MATMUL_LOWERING == "mixed":
+        return jax.lax.dot_general(
+            x, q, (((x.ndim - 1,), (dim,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return jax.lax.dot_general(
+        x, q.astype(x.dtype), (((x.ndim - 1,), (dim,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def matmul(x: jax.Array, w) -> jax.Array:
     """``x @ w`` where ``w`` is a plain array or a QTensor slice.
 
@@ -94,7 +114,7 @@ def matmul(x: jax.Array, w) -> jax.Array:
                 f"matmul expects per-output-channel scales (..., 1, d_out); "
                 f"got scale shape {w.scale.shape}"
             )
-        y = jnp.matmul(x, w.q.astype(x.dtype)).astype(jnp.float32)
+        y = _qdot(x, w.q, 0)
         return (y * w.scale.reshape((1,) * (y.ndim - 1) + (-1,))).astype(x.dtype)
     return x @ w
 
@@ -124,12 +144,7 @@ def head_matmul(hidden: jax.Array, head) -> jax.Array:
     float32 logits (..., V).  The int8 operand converts inside the fused
     einsum; per-vocab-row scales apply to the f32 product."""
     if isinstance(head, QTensor):
-        return jnp.einsum(
-            "...d,vd->...v",
-            hidden,
-            head.q.astype(hidden.dtype),
-            preferred_element_type=jnp.float32,
-        ) * head.scale[:, 0]
+        return _qdot(hidden, head.q, 1) * head.scale[:, 0]
     return jnp.einsum("...d,vd->...v", hidden, head, preferred_element_type=jnp.float32)
 
 

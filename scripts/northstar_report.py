@@ -92,10 +92,29 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
 
     total_wall = sum(r["wall_s"] for r in rows)
     total_statements = sum(r.get("statements", 0) for r in rows)
+    # Self-describe the backend (e.g. quantization mode).  If configs in
+    # the sweep disagree, say so rather than stamping one config's options
+    # over a heterogeneous run.
+    import yaml
+
+    seen_options = []
+    for row in rows:
+        cfg_path = pathlib.Path(row["config"])
+        if cfg_path.exists():
+            opts = yaml.safe_load(cfg_path.read_text()).get("backend_options") or {}
+            if opts not in seen_options:
+                seen_options.append(opts)
+    if not seen_options:
+        backend_options = {}
+    elif len(seen_options) == 1:
+        backend_options = seen_options[0]
+    else:
+        backend_options = {"mixed": seen_options}
     report = {
         "generated": datetime.now().isoformat(timespec="seconds"),
         "hardware": "1x TPU v5e chip (tunneled axon; north star targets v5e-8)",
         "weights": "random (no checkpoint on the box; timings/shapes real)",
+        "backend_options": backend_options,
         "configs_completed": len(rows),
         "total_wall_s": round(total_wall, 1),
         "total_statements": total_statements,
@@ -116,6 +135,7 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
         f"- Generated: {report['generated']}",
         f"- Hardware: {report['hardware']}",
         f"- Weights: {report['weights']}",
+        f"- Backend: {backend_options or 'n/a'}",
         f"- Configs: {len(rows)} | statements: {total_statements} "
         f"(errors: {report['total_errors']}, random-weight degenerate: "
         f"{report['degenerate_statements']}) | "
