@@ -146,9 +146,9 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
         f"- Hardware: {report['hardware']}",
         f"- Weights: {report['weights']}",
         f"- Backend: {backend_options or 'n/a'}",
-        "- Note: the first configs of the run pay the one-time remote-AOT "
-        "compile of every (shape-bucket, program) pair; later scenarios "
-        "reuse them warm.",
+        "- Note: configs meeting a (shape-bucket, program) pair for the "
+        "first time since the compile cache was last cold pay its one-time "
+        "remote-AOT compile; repeat configs run warm.",
         f"- Configs: {len(rows)} | statements: {total_statements} "
         f"(errors: {report['total_errors']}, random-weight degenerate: "
         f"{report['degenerate_statements']}) | "
@@ -201,7 +201,9 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
                 s["statements"] * s["api_baseline_s_per_statement"]
                 for s in methods.values()
             ) / statements
-        speedup = f"{weighted_base / cell:.0f}x" if weighted_base else "-"
+        speedup = (
+            f"{weighted_base / cell:.0f}x" if weighted_base and cell else "-"
+        )
         breakdown = ", ".join(
             f"{m}:{s['statements']}" for m, s in methods.items()
         )
