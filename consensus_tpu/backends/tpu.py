@@ -63,6 +63,10 @@ logger = logging.getLogger(__name__)
 
 #: Above this vocab size the streaming scorer replaces full-logit scoring.
 _STREAMED_VOCAB_THRESHOLD = 32_768
+#: Cap on the shared-scoring suffix attention's per-layer fp32 logits
+#: transient (rows x heads x span x (ctx+span) x 4B) — it has no flash
+#: kernel, so oversized groups fall back to the classic (flash) path.
+_SHARED_SCORE_ATTN_BYTES_CAP = 1 << 31  # 2 GB
 
 #: Search-session KV caches above this (plus resident weights) risk HBM
 #: exhaustion — fall back to the cacheless full-prefix session instead.
@@ -136,6 +140,7 @@ class TPUBackend:
         use_flash_attention: bool = False,
         max_batch_rows: int = 64,
         quantization: Optional[str] = None,
+        shared_context_scoring: bool = False,
     ):
         self.config = config if config is not None else get_model_config(model)
         if use_flash_attention and not self.config.use_flash_attention:
@@ -160,6 +165,7 @@ class TPUBackend:
         # must not scale with the sweep size.  Each public call processes
         # ceil(B / max_batch_rows) jitted slices and concatenates.
         self.max_batch_rows = max(1, max_batch_rows)
+        self.shared_context_scoring = bool(shared_context_scoring)
 
         if quantization not in (None, "none", "int8"):
             raise ValueError(f"unknown quantization mode: {quantization!r}")
@@ -504,32 +510,165 @@ class TPUBackend:
 
     # -- score ---------------------------------------------------------------
 
-    def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
-        return self._sliced(requests, self._score_impl)
+    def _score_prefix(self, request: ScoreRequest) -> str:
+        prefix = (
+            f"{request.system_prompt}\n\n{request.context}"
+            if request.system_prompt
+            else request.context
+        )
+        if request.chat and request.role == "user":
+            # Reference evaluation semantics (src/evaluation.py:182-193):
+            # the eval template sits in the system slot and the statement
+            # is scored INSIDE the user turn.
+            parts = [p for p in (request.system_prompt, request.context) if p]
+            prefix = self.tokenizer.user_turn_prefix("\n\n".join(parts) or None)
+        elif request.chat:
+            prefix = self.tokenizer.chat_prompt(request.context, request.system_prompt)
+        return prefix
 
-    def _score_impl(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+    def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+        """Teacher-forced scoring; requests sharing a context prefill it ONCE.
+
+        best_of_n / evaluation score many candidates under the same agent
+        context (reference best_of_n.py:266-321) — re-running the ~1k-token
+        context forward per candidate is O(P·(C+L)).  With
+        ``shared_context_scoring`` enabled, requests are grouped by rendered
+        prefix; groups of >=4 that fit the window go through
+        ``shared_context_token_logprobs`` (O(C + P·L), trunk broadcast) —
+        measured 3.4x faster than the classic path on a bon-shaped batch
+        (445 reqs, 1k ctx, Gemma-2B int8, one v5e: 5.8s vs 19.8s warm).
+        Default OFF: on the tunneled shared chip the in-situ sweep numbers
+        were too noisy to certify an end-to-end win this round.
+        """
+        if not requests:
+            return []
+        if not self.shared_context_scoring:
+            return self._sliced(requests, self._score_impl)
+        prepared = []
+        for request in requests:
+            prefix = self._score_prefix(request)
+            prepared.append(
+                (
+                    prefix,
+                    self.tokenizer.encode(prefix, add_bos=True),
+                    self.tokenizer.encode(request.continuation),
+                )
+            )
+        by_prefix: Dict[str, List[int]] = {}
+        for i, (prefix, _, _) in enumerate(prepared):
+            by_prefix.setdefault(prefix, []).append(i)
+
+        results: List[Optional[ScoreResult]] = [None] * len(requests)
+        legacy: List[int] = []
+        for prefix, idxs in by_prefix.items():
+            ctx_ids = prepared[idxs[0]][1]
+            conts = [prepared[i][2] for i in idxs]
+            max_cont = max((len(c) for c in conts), default=0)
+            # The suffix attention materializes per-layer fp32 logits of
+            # (rows, heads, span, ctx+span) — unlike the classic path it has
+            # no flash kernel, so bound that transient explicitly.
+            attn_bytes = (
+                self.max_batch_rows * self.config.n_heads
+                * max_cont * (len(ctx_ids) + max_cont) * 4
+            )
+            fits = (
+                # >=4 rows: below that the single-row prefill + padded
+                # suffix costs more than riding a wide legacy batch.
+                len(idxs) >= 4
+                and all(conts)
+                and ctx_ids
+                and len(ctx_ids) + max_cont <= self.max_context
+                and attn_bytes <= _SHARED_SCORE_ATTN_BYTES_CAP
+            )
+            if not fits:
+                legacy.extend(idxs)
+                continue
+            for start in range(0, len(idxs), self.max_batch_rows):
+                chunk = idxs[start : start + self.max_batch_rows]
+                if len(chunk) < 4:  # sub-threshold tail: ride the wide batch
+                    legacy.extend(chunk)
+                    continue
+                self._score_shared_group(ctx_ids, chunk, prepared, results)
+        if legacy:
+            for start in range(0, len(legacy), self.max_batch_rows):
+                chunk = legacy[start : start + self.max_batch_rows]
+                chunk_results = self._score_impl(
+                    [requests[i] for i in chunk],
+                    prepared=[(prepared[i][1], prepared[i][2]) for i in chunk],
+                )
+                for i, result in zip(chunk, chunk_results):
+                    results[i] = result
+        return results  # type: ignore[return-value]
+
+    def _score_shared_group(
+        self,
+        ctx_ids: List[int],
+        idxs: List[int],
+        prepared,
+        results,
+    ) -> None:
+        from consensus_tpu.models.transformer import shared_context_token_logprobs
+
+        self.call_counts["score"] += len(idxs)
+        conts = [prepared[i][2] for i in idxs]
+        # Shape discipline: every program here is a fresh remote-AOT compile,
+        # so the variant space must stay SMALL.  Rows always pad to the one
+        # max_batch_rows bucket (padded suffix rows are cheap — the prefill
+        # dominates), and continuation width uses a coarse pow2 ladder.
+        n_rows = self.max_batch_rows
+        width = 64
+        while width < max(len(c) for c in conts):
+            width *= 2
+        width = min(width, self.max_context)
+        ctx_width = min(_width_bucket(len(ctx_ids)), self.max_context)
+        pad = self.tokenizer.pad_id
+        ctx_tokens = np.full((1, ctx_width), pad, np.int32)
+        ctx_tokens[0, : len(ctx_ids)] = ctx_ids
+        ctx_valid = np.zeros((1, ctx_width), bool)
+        ctx_valid[0, : len(ctx_ids)] = True
+        cont_tokens = np.full((n_rows, width), pad, np.int32)
+        cont_valid = np.zeros((n_rows, width), bool)
+        for row, ids in enumerate(conts):
+            cont_tokens[row, : len(ids)] = ids
+            cont_valid[row, : len(ids)] = True
+        logprobs = np.asarray(
+            shared_context_token_logprobs(
+                self.params,
+                self.config,
+                jnp.asarray(ctx_tokens),
+                jnp.asarray(ctx_valid),
+                jnp.asarray(cont_tokens),
+                jnp.asarray(cont_valid),
+            )
+        )
+        for row, i in enumerate(idxs):
+            ids = conts[row]
+            results[i] = ScoreResult(
+                tokens=tuple(self.tokenizer.token_str(t) for t in ids),
+                logprobs=tuple(float(v) for v in logprobs[row, : len(ids)]),
+            )
+
+    def _score_impl(
+        self,
+        requests: Sequence[ScoreRequest],
+        prepared: Optional[Sequence[Tuple[List[int], List[int]]]] = None,
+    ) -> List[ScoreResult]:
+        """Classic full-sequence batch scorer.  ``prepared`` carries
+        already-encoded (context_ids, continuation_ids) so the shared-path
+        router does not pay tokenization twice for its legacy fallbacks."""
         self.call_counts["score"] += len(requests)
         if not requests:
             return []
 
         rows = []
         spans = []  # (context_len, continuation_len) per row
-        for request in requests:
-            prefix = (
-                f"{request.system_prompt}\n\n{request.context}"
-                if request.system_prompt
-                else request.context
-            )
-            if request.chat and request.role == "user":
-                # Reference evaluation semantics (src/evaluation.py:182-193):
-                # the eval template sits in the system slot and the statement
-                # is scored INSIDE the user turn.
-                parts = [p for p in (request.system_prompt, request.context) if p]
-                prefix = self.tokenizer.user_turn_prefix("\n\n".join(parts) or None)
-            elif request.chat:
-                prefix = self.tokenizer.chat_prompt(request.context, request.system_prompt)
-            context_ids = self.tokenizer.encode(prefix, add_bos=True)
-            continuation_ids = self.tokenizer.encode(request.continuation)
+        for i, request in enumerate(requests):
+            if prepared is not None:
+                context_ids, continuation_ids = prepared[i]
+            else:
+                prefix = self._score_prefix(request)
+                context_ids = self.tokenizer.encode(prefix, add_bos=True)
+                continuation_ids = self.tokenizer.encode(request.continuation)
             rows.append(context_ids + continuation_ids)
             spans.append((len(context_ids), len(continuation_ids)))
 

@@ -1,0 +1,194 @@
+"""Shared-context scoring (one prefill, broadcast-trunk continuations).
+
+The scorer must be indistinguishable from the full-sequence path: the
+backend routes same-context groups through
+``shared_context_token_logprobs`` and everything else through the classic
+batch, and both must yield identical ScoreResults.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensus_tpu.backends.base import ScoreRequest
+from consensus_tpu.backends.tpu import TPUBackend
+from consensus_tpu.models import transformer as T
+from consensus_tpu.models.config import get_model_config
+from consensus_tpu.models.quant import quantize_params
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = get_model_config("tiny-gemma2")
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _ragged_conts(cfg, lengths, seed=3):
+    rng = np.random.default_rng(seed)
+    conts = [rng.integers(1, cfg.vocab_size, size=n).tolist() for n in lengths]
+    width = max(lengths)
+    tokens = np.zeros((len(conts), width), np.int32)
+    valid = np.zeros((len(conts), width), bool)
+    for i, ids in enumerate(conts):
+        tokens[i, : len(ids)] = ids
+        valid[i, : len(ids)] = True
+    return conts, jnp.asarray(tokens), jnp.asarray(valid)
+
+
+class TestPrimitive:
+    def test_matches_full_sequence_scorer(self, setup):
+        """Exact parity with token_logprobs_streamed on the concatenation —
+        incl. sliding-window layers crossing the context boundary
+        (tiny-gemma2 window=16 < ctx+cont)."""
+        cfg, params = setup
+        C = 24
+        ctx = jnp.asarray(
+            np.random.default_rng(1).integers(1, cfg.vocab_size, size=(1, C)),
+            jnp.int32,
+        )
+        conts, cont_tok, cont_val = _ragged_conts(cfg, [8, 5, 1])
+        shared = np.asarray(
+            T.shared_context_token_logprobs(
+                params, cfg, ctx, jnp.ones((1, C), bool), cont_tok, cont_val,
+                vocab_chunk=64,
+            )
+        )
+        for i, ids in enumerate(conts):
+            full = jnp.asarray(
+                np.concatenate([np.asarray(ctx[0]), ids])[None], jnp.int32
+            )
+            oracle = np.asarray(
+                T.token_logprobs_streamed(
+                    params, cfg, full, jnp.ones_like(full, bool), vocab_chunk=64
+                )
+            )[0, C : C + len(ids)]
+            np.testing.assert_allclose(shared[i, : len(ids)], oracle, atol=1e-5)
+            assert (shared[i, len(ids):] == 0.0).all()
+
+    def test_right_padded_context(self, setup):
+        """A right-padded context row must score like its unpadded form."""
+        cfg, params = setup
+        rng = np.random.default_rng(5)
+        real = rng.integers(1, cfg.vocab_size, size=12)
+        padded = np.zeros((1, 20), np.int32)
+        padded[0, :12] = real
+        ctx_valid = np.zeros((1, 20), bool)
+        ctx_valid[0, :12] = True
+        conts, cont_tok, cont_val = _ragged_conts(cfg, [6, 4], seed=6)
+        a = np.asarray(
+            T.shared_context_token_logprobs(
+                params, cfg, jnp.asarray(padded), jnp.asarray(ctx_valid),
+                cont_tok, cont_val, vocab_chunk=64,
+            )
+        )
+        b = np.asarray(
+            T.shared_context_token_logprobs(
+                params, cfg, jnp.asarray(real[None].astype(np.int32)),
+                jnp.ones((1, 12), bool), cont_tok, cont_val, vocab_chunk=64,
+            )
+        )
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_int8_params_supported(self, setup):
+        cfg, params = setup
+        qp = quantize_params(params)
+        C = 16
+        ctx = jnp.asarray(
+            np.random.default_rng(7).integers(1, cfg.vocab_size, size=(1, C)),
+            jnp.int32,
+        )
+        conts, cont_tok, cont_val = _ragged_conts(cfg, [5, 5], seed=8)
+        shared = np.asarray(
+            T.shared_context_token_logprobs(
+                qp, cfg, ctx, jnp.ones((1, C), bool), cont_tok, cont_val,
+                vocab_chunk=64,
+            )
+        )
+        full = np.asarray(
+            T.token_logprobs_streamed(
+                qp, cfg,
+                jnp.asarray(np.concatenate([np.asarray(ctx[0]), conts[0]])[None],
+                            jnp.int32),
+                jnp.ones((1, C + 5), bool), vocab_chunk=64,
+            )
+        )[0, C:]
+        np.testing.assert_allclose(shared[0, :5], full, atol=1e-4)
+
+
+class TestBackendRouting:
+    @pytest.fixture(scope="class")
+    def backend(self):
+        return TPUBackend(
+            model="tiny-gemma2", dtype="float32", max_context=128,
+            shared_context_scoring=True,
+        )
+
+    def test_default_off_uses_legacy_path(self):
+        """With the option off (default), grouped requests still score
+        correctly through the classic batch."""
+        legacy = TPUBackend(model="tiny-gemma2", dtype="float32", max_context=128)
+        reqs = [
+            ScoreRequest(context="ctx", continuation=c)
+            for c in ("aa", "bb", "cc", "dd")
+        ]
+        results = legacy.score(reqs)
+        assert all(r.ok for r in results)
+
+    def test_grouped_equals_individual(self, backend):
+        """Candidates sharing one context (shared path, group >=4) must
+        score exactly like each scored alone (legacy path: single-request
+        groups fall through to the classic batch)."""
+        context = "Issue: parks.\n\nAgent's opinion:\nMore green space.\n\n"
+        cands = [
+            "We should build parks.",
+            "No new parks.",
+            "Pilot one park.",
+            "Let residents vote.",
+        ]
+        grouped = backend.score(
+            [ScoreRequest(context=context, continuation=c) for c in cands]
+        )
+        for cand, got in zip(cands, grouped):
+            solo = backend.score(
+                [ScoreRequest(context=context, continuation=cand)]
+            )[0]
+            assert got.tokens == solo.tokens
+            np.testing.assert_allclose(
+                got.logprobs, solo.logprobs, atol=1e-4
+            )
+
+    def test_mixed_batch_order_preserved(self, backend):
+        """A batch mixing two context groups and a singleton returns results
+        in request order with the right spans."""
+        reqs = [
+            ScoreRequest(context="ctx A", continuation="one"),
+            ScoreRequest(context="ctx B", continuation="two"),
+            ScoreRequest(context="ctx A", continuation="three"),
+            ScoreRequest(context="ctx C", continuation="four"),
+            ScoreRequest(context="ctx B", continuation="five"),
+            ScoreRequest(context="ctx A", continuation="six"),
+            ScoreRequest(context="ctx A", continuation="seven"),
+        ]
+        results = backend.score(reqs)
+        assert len(results) == 7
+        for req, res in zip(reqs, results):
+            assert res.ok
+            assert "".join(res.tokens) == req.continuation
+            assert len(res.logprobs) == len(res.tokens)
+
+    def test_oversized_group_falls_back(self, backend):
+        """Context too long for the window -> legacy truncating path, which
+        still returns a (possibly shortened) valid span."""
+        context = "x" * 500  # byte tokenizer: 500 tokens >> max_context=128
+        results = backend.score(
+            [
+                ScoreRequest(context=context, continuation="abcdef"),
+                ScoreRequest(context=context, continuation="ghijkl"),
+            ]
+        )
+        for res in results:
+            assert res.ok
+            assert all(lp <= 1e-5 for lp in res.logprobs)
