@@ -25,6 +25,9 @@ class ModelConfig:
     # "geglu" (Gemma: gelu-tanh gated) or "swiglu" (Llama: silu gated)
     activation: str = "geglu"
     rope_theta: float = 10_000.0
+    # Llama-3.1 "llama3" rope scaling as (factor, low_freq_factor,
+    # high_freq_factor, original_max_position_embeddings); None disables.
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
     rms_eps: float = 1e-6
     # Gemma-2 style logit softcaps; None disables.
     attn_softcap: Optional[float] = None
@@ -125,7 +128,9 @@ MODEL_CONFIGS = {
         query_pre_attn_scalar=224,
     ),
     # Llama-3.1 8B (meta-llama/Meta-Llama-3.1-8B-Instruct-Turbo in the
-    # reference's main-body configs): 32 layers, d=4096, 32 q / 8 kv heads.
+    # reference's main-body configs): 32 layers, d=4096, 32 q / 8 kv heads,
+    # "llama3" rope scaling (HF config.json rope_scaling; certified against
+    # transformers in tests/test_hf_numerics.py).
     "llama3-8b": _llama3(
         "llama3-8b",
         vocab_size=128_256,
@@ -135,6 +140,7 @@ MODEL_CONFIGS = {
         n_kv_heads=8,
         head_dim=128,
         ffn_hidden=14336,
+        rope_scaling=(8.0, 1.0, 4.0, 8192),
     ),
     # Tiny variants for tests / CPU smoke runs.
     "tiny-gemma2": _gemma2(

@@ -181,19 +181,35 @@ class HFTokenizer:
     def token_str(self, token_id: int) -> str:
         return self._tok.decode([int(token_id)])
 
+    # The Llama-3.1 chat template (the reference's main-body generation
+    # model is Meta-Llama-3.1-8B-Instruct-Turbo, whose server-side template
+    # Together applies on every call) ALWAYS emits a system header carrying
+    # knowledge-cutoff/date lines — even when no system message is given.
+    # The date is pinned to the template's own default so prompts are
+    # reproducible run to run.
+    _LLAMA31_DATE_BLOCK = (
+        "Cutting Knowledge Date: December 2023\nToday Date: 26 Jul 2024\n\n"
+    )
+
+    def _llama_system_block(self, system: Optional[str]) -> str:
+        return (
+            "<|start_header_id|>system<|end_header_id|>\n\n"
+            + self._LLAMA31_DATE_BLOCK
+            + (system or "")
+            + "<|eot_id|>"
+        )
+
     def chat_prompt(self, user: str, system: Optional[str] = None) -> str:
         if self.family == "gemma":
             # Gemma has no system role; fold system into the user turn.
             content = f"{system}\n\n{user}" if system else user
             return f"<start_of_turn>user\n{content}<end_of_turn>\n<start_of_turn>model\n"
-        parts = ["<|begin_of_text|>"]
-        if system:
-            parts.append(
-                f"<|start_header_id|>system<|end_header_id|>\n\n{system}<|eot_id|>"
-            )
-        parts.append(f"<|start_header_id|>user<|end_header_id|>\n\n{user}<|eot_id|>")
-        parts.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
-        return "".join(parts)
+        return (
+            "<|begin_of_text|>"
+            + self._llama_system_block(system)
+            + f"<|start_header_id|>user<|end_header_id|>\n\n{user}<|eot_id|>"
+            + "<|start_header_id|>assistant<|end_header_id|>\n\n"
+        )
 
     def raw_prompt(self, user: str, system: Optional[str] = None) -> str:
         return f"{system}\n\n{user}" if system else user
@@ -203,13 +219,11 @@ class HFTokenizer:
             # No system role: the system text leads the user turn.
             lead = f"{system}\n\n" if system else ""
             return f"<start_of_turn>user\n{lead}"
-        parts = ["<|begin_of_text|>"]
-        if system:
-            parts.append(
-                f"<|start_header_id|>system<|end_header_id|>\n\n{system}<|eot_id|>"
-            )
-        parts.append("<|start_header_id|>user<|end_header_id|>\n\n")
-        return "".join(parts)
+        return (
+            "<|begin_of_text|>"
+            + self._llama_system_block(system)
+            + "<|start_header_id|>user<|end_header_id|>\n\n"
+        )
 
     @functools.lru_cache(maxsize=512)
     def token_ids_containing(self, text: str) -> List[int]:

@@ -118,16 +118,44 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float, style: str) -> jax.Arr
     return (normed * scale).astype(dtype)
 
 
-def _rope_angles(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+def _rope_angles(
+    positions: jax.Array,
+    head_dim: int,
+    theta: float,
+    scaling: Optional[Tuple[float, float, float, int]] = None,
+) -> Tuple[jax.Array, jax.Array]:
     half = head_dim // 2
     freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        # Llama-3.1 "llama3" rope scaling: long wavelengths are divided by
+        # ``factor``, short ones kept, mid-band smoothly interpolated.
+        # (The reference's main-body generation model is
+        # Meta-Llama-3.1-8B-Instruct-Turbo, configs/main_body/*.yaml.)
+        factor, low_freq_factor, high_freq_factor, original_max = scaling
+        wavelen = 2.0 * jnp.pi / freq
+        low_freq_wavelen = original_max / low_freq_factor
+        high_freq_wavelen = original_max / high_freq_factor
+        smooth = (original_max / wavelen - low_freq_factor) / (
+            high_freq_factor - low_freq_factor
+        )
+        interp = (1.0 - smooth) * freq / factor + smooth * freq
+        freq = jnp.where(
+            wavelen > low_freq_wavelen,
+            freq / factor,
+            jnp.where(wavelen < high_freq_wavelen, freq, interp),
+        )
     angles = positions[..., None].astype(jnp.float32) * freq  # (..., half)
     return jnp.cos(angles), jnp.sin(angles)
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float,
+    scaling: Optional[Tuple[float, float, float, int]] = None,
+) -> jax.Array:
     """Rotate (B, S, H, hd) by per-token positions (B, S). Half-split layout."""
-    cos, sin = _rope_angles(positions, x.shape[-1], theta)
+    cos, sin = _rope_angles(positions, x.shape[-1], theta, scaling)
     cos = cos[:, :, None, :]  # (B, S, 1, half)
     sin = sin[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
@@ -248,8 +276,8 @@ def forward(
         q = matmul(attn_in, lp["wq"]).reshape(batch, span, h, hd)
         k = matmul(attn_in, lp["wk"]).reshape(batch, span, kv, hd)
         v = matmul(attn_in, lp["wv"]).reshape(batch, span, kv, hd)
-        q = apply_rope(q, positions, c.rope_theta)
-        k = apply_rope(k, positions, c.rope_theta)
+        q = apply_rope(q, positions, c.rope_theta, c.rope_scaling)
+        k = apply_rope(k, positions, c.rope_theta, c.rope_scaling)
 
         if k_cache_l is None:
             keys, values = k, v
@@ -421,8 +449,8 @@ def forward_trunk_tail(
         q = matmul(attn_in, lp["wq"]).reshape(rows, 1, h, hd)
         k = matmul(attn_in, lp["wk"]).reshape(rows, 1, kv, hd)
         v = matmul(attn_in, lp["wv"]).reshape(rows, 1, kv, hd)
-        q = apply_rope(q, positions[:, None], c.rope_theta)
-        k = apply_rope(k, positions[:, None], c.rope_theta)
+        q = apply_rope(q, positions[:, None], c.rope_theta, c.rope_scaling)
+        k = apply_rope(k, positions[:, None], c.rope_theta, c.rope_scaling)
 
         new_k_tail = jax.lax.dynamic_update_slice(
             k_tail, k, (0, write_col, 0, 0)
@@ -546,8 +574,8 @@ def forward_shared_trunk(
         ks = matmul(flat, lp["wk"]).reshape(n_paths * n_roles, span, kv, hd)
         vs = matmul(flat, lp["wv"]).reshape(n_paths * n_roles, span, kv, hd)
         rope_pos = jnp.tile(positions, (n_paths, 1))  # (P*R, L)
-        q = apply_rope(q, rope_pos, c.rope_theta)
-        ks = apply_rope(ks, rope_pos, c.rope_theta)
+        q = apply_rope(q, rope_pos, c.rope_theta, c.rope_scaling)
+        ks = apply_rope(ks, rope_pos, c.rope_theta, c.rope_scaling)
         qg = q.reshape(n_paths, n_roles, span, kv, reps, hd)
         ks = ks.reshape(n_paths, n_roles, span, kv, hd)
         vs = vs.reshape(n_paths, n_roles, span, kv, hd)
