@@ -135,6 +135,7 @@ class TPUBackend:
         max_context: int = 1024,
         base_seed: int = 0,
         tp: int = 1,
+        dp: Optional[int] = None,
         params: Optional[Dict[str, Any]] = None,
         config: Optional[ModelConfig] = None,
         use_flash_attention: bool = False,
@@ -231,10 +232,17 @@ class TPUBackend:
                     self.params = jax.jit(quantize_params)(self.params)
         self.quantization = quantization if quantization != "none" else None
 
-        if tp > 1:
+        if tp > 1 or (dp is not None and dp > 1):
+            # Pure DP (tp=1, dp>1) is the production multi-chip serving mode
+            # (SURVEY §2.16 table / §5.8): params replicate over ``data`` —
+            # the TP PartitionSpecs never name the data axis, so shard_params
+            # on a (dp, 1) mesh replicates every leaf — and the protocol
+            # batch rows shard over ``data`` (see _left_pad_batch /
+            # _score_impl).  A sweep's co-batched rows then run dp-wide with
+            # XLA inserting no per-layer collectives at all.
             from consensus_tpu.parallel import make_mesh, shard_params
 
-            self.mesh_plan = make_mesh(tp=tp)
+            self.mesh_plan = make_mesh(tp=tp, dp=dp)
             self.params = shard_params(self.params, self.mesh_plan.mesh)
         else:
             self.mesh_plan = None
@@ -248,11 +256,13 @@ class TPUBackend:
         # Live-session HBM budget: what a v5e chip holds after the resident
         # weights and a reserve for per-call activation transients (merged
         # score/generate batches run concurrently with session steps).
-        # PER-CHIP accounting: under tensor parallelism both the weights and
-        # the session KV caches shard over the mesh.
-        self._shard_count = (
-            self.mesh_plan.mesh.devices.size if self.mesh_plan else 1
-        )
+        # PER-CHIP accounting: weights and KV caches shard over ``model``
+        # only — over ``data`` the weights replicate (each chip holds the
+        # full tree at tp=1), so the divisor is tp, not the device count.
+        # DP's capacity win shows up in _generate_rows_allowed instead:
+        # batch rows spread over the data axis.
+        self._shard_count = self.mesh_plan.tp if self.mesh_plan else 1
+        self._dp = self.mesh_plan.dp if self.mesh_plan else 1
         self._params_bytes = sum(
             x.size * jnp.dtype(x.dtype).itemsize
             for x in jax.tree_util.tree_leaves(self.params)
@@ -293,6 +303,18 @@ class TPUBackend:
         longest = min(max(len(t) for t in token_lists), self.max_context)
         return min(_width_bucket(longest), self.max_context)
 
+    def _place_batch(self, *arrays):
+        """Commit batch-leading arrays to the mesh, rows sharded over
+        ``data``.  Rows that don't divide dp (sessions with odd role counts)
+        stay uncommitted — jit replicates them, still correct.  Single-device
+        backends pass through."""
+        if self._dp > 1 and all(a.shape[0] % self._dp == 0 for a in arrays):
+            from consensus_tpu.parallel.mesh import shard_batch
+
+            placed = shard_batch(self.mesh_plan.mesh, *arrays)
+            return placed if len(arrays) > 1 else (placed,)
+        return tuple(jnp.asarray(a) for a in arrays)
+
     def _left_pad_batch(
         self, token_lists: List[List[int]]
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -305,7 +327,8 @@ class TPUBackend:
             ids = ids[-width:]  # keep the most recent context
             tokens[row, width - len(ids):] = ids
             valid[row, width - len(ids):] = True
-        return jnp.asarray(tokens), jnp.asarray(valid)
+        tokens, valid = self._place_batch(tokens, valid)
+        return tokens, valid
 
     def _bias_table(
         self, requests: Sequence
@@ -409,7 +432,10 @@ class TPUBackend:
             bucket *= 2
         if bucket >= 2 and bucket + bucket // 2 <= allowed:
             bucket += bucket // 2
-        return bucket
+        # Pure DP: batch rows shard over ``data``, so dp chips hold dp x the
+        # rows.  Scaling the per-chip ladder keeps every chunk size divisible
+        # by dp (so _place_batch can actually shard it).
+        return bucket * self._dp
 
     def _generate_impl(
         self,
@@ -448,9 +474,10 @@ class TPUBackend:
         # varying candidate counts every step).  Dummy rows are all-invalid
         # and their outputs are never read.  The pad floor respects the HBM
         # row allowance (a floor of 8 with 2 allowed would defeat it).
-        pad_rows = min(
-            _bucket(len(requests), minimum=min(8, allowed)), allowed
-        ) - len(requests)
+        target = min(_bucket(len(requests), minimum=min(8, allowed)), allowed)
+        if target % self._dp:  # dp > 8: pow-of-two buckets may undershoot
+            target = min(-(-target // self._dp) * self._dp, allowed)
+        pad_rows = target - len(requests)
         token_lists = list(token_lists) + [[]] * pad_rows
         tokens, valid = self._left_pad_batch(token_lists)
         temperatures = jnp.asarray(
@@ -631,14 +658,15 @@ class TPUBackend:
         for row, ids in enumerate(conts):
             cont_tokens[row, : len(ids)] = ids
             cont_valid[row, : len(ids)] = True
+        cont_tokens_dev, cont_valid_dev = self._place_batch(cont_tokens, cont_valid)
         logprobs = np.asarray(
             shared_context_token_logprobs(
                 self.params,
                 self.config,
                 jnp.asarray(ctx_tokens),
                 jnp.asarray(ctx_valid),
-                jnp.asarray(cont_tokens),
-                jnp.asarray(cont_valid),
+                cont_tokens_dev,
+                cont_valid_dev,
             )
         )
         for row, i in enumerate(idxs):
@@ -711,8 +739,9 @@ class TPUBackend:
             if self.config.vocab_size > _STREAMED_VOCAB_THRESHOLD
             else token_logprobs
         )
+        tokens_dev, valid_dev = self._place_batch(tokens, valid)
         logprobs = np.asarray(
-            scorer(self.params, self.config, jnp.asarray(tokens), jnp.asarray(valid))
+            scorer(self.params, self.config, tokens_dev, valid_dev)
         )
 
         results = []

@@ -54,16 +54,17 @@ def make_mesh(
 
     ``tp`` is the tensor-parallel degree; remaining devices become data
     parallel.  ``tp=1`` (pure DP, model replicated) is the right default for
-    the 2B/9B models of the reference workload (SURVEY §5.8).
+    the 2B/9B models of the reference workload (SURVEY §5.8).  An explicit
+    ``dp`` smaller than ``n // tp`` uses the first ``dp * tp`` devices.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if n % tp != 0:
         raise ValueError(f"tp={tp} does not divide device count {n}")
     dp = dp if dp is not None else n // tp
-    if dp * tp != n:
-        raise ValueError(f"dp*tp = {dp * tp} != device count {n}")
-    grid = np.array(devices).reshape(dp, tp)
+    if dp * tp > n:
+        raise ValueError(f"dp*tp = {dp * tp} > device count {n}")
+    grid = np.array(devices[: dp * tp]).reshape(dp, tp)
     return MeshPlan(mesh=Mesh(grid, (DATA_AXIS, MODEL_AXIS)), dp=dp, tp=tp)
 
 

@@ -1,0 +1,128 @@
+"""Pure data-parallel multi-chip SERVING (SURVEY §2.16 table, §5.8).
+
+The production multi-chip mode for the 2B/9B models is tp=1, dp=N: params
+replicated, protocol batch rows sharded over the ``data`` mesh axis.  These
+tests prove on the 8-virtual-device CPU mesh that a dp=8 backend returns
+per-row results identical to the single-device backend — per-request PRNG
+keys make results independent of batch composition AND of device layout.
+"""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.base import (
+    GenerationRequest,
+    NextTokenRequest,
+    ScoreRequest,
+)
+from consensus_tpu.backends.tpu import TPUBackend
+
+
+@pytest.fixture(scope="module")
+def single():
+    return TPUBackend(model="tiny-gemma2", max_context=128, base_seed=7)
+
+
+@pytest.fixture(scope="module")
+def dp8():
+    backend = TPUBackend(model="tiny-gemma2", max_context=128, base_seed=7, dp=8)
+    assert backend.mesh_plan is not None
+    assert backend.mesh_plan.dp == 8 and backend.mesh_plan.tp == 1
+    return backend
+
+
+PROMPTS = [f"Opinion {i}: the city should plant more trees." for i in range(12)]
+
+
+def test_dp_generate_matches_single_device(single, dp8):
+    requests = [
+        GenerationRequest(user_prompt=p, max_tokens=8, seed=100 + i, temperature=0.7)
+        for i, p in enumerate(PROMPTS)
+    ]
+    ours = dp8.generate(requests)
+    ref = single.generate(requests)
+    assert [r.token_ids for r in ours] == [r.token_ids for r in ref]
+
+
+def test_dp_score_matches_single_device(single, dp8):
+    requests = [
+        ScoreRequest(context=f"Agent {i} believes trees matter.", continuation=p)
+        for i, p in enumerate(PROMPTS)
+    ]
+    ours = dp8.score(requests)
+    ref = single.score(requests)
+    for a, b in zip(ours, ref):
+        assert a.tokens == b.tokens
+        np.testing.assert_allclose(a.logprobs, b.logprobs, atol=1e-5, rtol=1e-5)
+
+
+def test_dp_next_token_matches_single_device(single, dp8):
+    requests = [
+        NextTokenRequest(user_prompt=p, k=4, seed=i, temperature=0.8)
+        for i, p in enumerate(PROMPTS)
+    ]
+    ours = dp8.next_token_logprobs(requests)
+    ref = single.next_token_logprobs(requests)
+    for a, b in zip(ours, ref):
+        assert [c.token_id for c in a] == [c.token_id for c in b]
+
+
+def test_dp_embed_matches_single_device(single, dp8):
+    ours = dp8.embed(PROMPTS)
+    ref = single.embed(PROMPTS)
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_dp_session_matches_single_device(single, dp8):
+    """Token-search sessions on a DP backend: rows may not divide dp (role
+    counts are odd); the batch then stays uncommitted/replicated — a full
+    propose/advance step must run and match the single-device session."""
+    from consensus_tpu.backends.session import SearchSpec, open_token_search
+
+    spec = SearchSpec(
+        ref_system="You draft consensus statements.",
+        ref_user="Issue: trees.\nStatement:",
+        agent_prompts=(
+            ("Agent context.", "Opinion: plant more.\nStatement:"),
+            ("Agent context.", "Opinion: too costly.\nStatement:"),
+        ),
+        n_slots=2,
+        k=3,
+        temperature=1.0,
+        seed=11,
+        sample=False,
+        max_steps=4,
+    )
+    s_dp = open_token_search(dp8, spec)
+    s_ref = open_token_search(single, spec)
+    try:
+        props_dp = s_dp.propose()
+        props_ref = s_ref.propose()
+        ids_dp = [[c.token_id for c in slot] for slot in props_dp]
+        ids_ref = [[c.token_id for c in slot] for slot in props_ref]
+        assert ids_dp == ids_ref
+        chosen = [props_dp[0][0], props_dp[1][1]]
+        next_dp = s_dp.advance_and_propose([0, 1], chosen)
+        next_ref = s_ref.advance_and_propose([0, 1], [props_ref[0][0], props_ref[1][1]])
+        assert [[c.token_id for c in slot] for slot in next_dp] == [
+            [c.token_id for c in slot] for slot in next_ref
+        ]
+    finally:
+        s_dp.close()
+        s_ref.close()
+
+
+def test_dp_welfare_pipeline_matches_single_device(single, dp8):
+    """End-to-end best_of_n under dp=8 equals the single-device run — the
+    statement picked, not just the tensors."""
+    from consensus_tpu.methods import get_method_generator
+
+    config = {"n": 4, "max_tokens": 8, "seed": 3, "temperature": 0.9}
+    issue = "Should the city center be car-free?"
+    opinions = {"Agent 1": "Yes, cleaner air.", "Agent 2": "No, deliveries."}
+
+    gen_dp = get_method_generator("best_of_n", dp8, config, "tiny-gemma2")
+    gen_single = get_method_generator("best_of_n", single, config, "tiny-gemma2")
+    assert gen_dp.generate_statement(issue, opinions) == gen_single.generate_statement(
+        issue, opinions
+    )
