@@ -84,6 +84,8 @@ class FakeBackend:
         self.embed_dim = embed_dim
         self.instruction_following = instruction_following
         self.call_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+        # Token-honest accounting mirroring TPUBackend (pseudo-tokens here).
+        self.token_counts = {"generated": 0, "scored": 0}
 
     # -- generation ---------------------------------------------------------
 
@@ -165,6 +167,7 @@ class FakeBackend:
                 idx = text.find(stop)
                 if idx >= 0:
                     text = text[:idx]
+            self.token_counts["generated"] += len(self._tokenize(text))
             results.append(GenerationResult(text=text, finish_reason="stop"))
         return results
 
@@ -192,6 +195,7 @@ class FakeBackend:
             for token in tokens:
                 logprobs.append(self.token_logprob(running, token))
                 running += token
+            self.token_counts["scored"] += len(tokens)
             results.append(ScoreResult(tokens=tuple(tokens), logprobs=tuple(logprobs)))
         return results
 
@@ -201,6 +205,7 @@ class FakeBackend:
         self, requests: Sequence[NextTokenRequest]
     ) -> List[List[TokenCandidate]]:
         self.call_counts["next_token"] += len(requests)
+        self.token_counts["scored"] += len(requests)
         out: List[List[TokenCandidate]] = []
         for req in requests:
             prompt = self._full_prompt(req)

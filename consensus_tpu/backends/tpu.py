@@ -142,6 +142,7 @@ class TPUBackend:
         max_batch_rows: int = 64,
         quantization: Optional[str] = None,
         shared_context_scoring: bool = False,
+        pin_generation_budget: bool = False,
     ):
         self.config = config if config is not None else get_model_config(model)
         if use_flash_attention and not self.config.use_flash_attention:
@@ -167,6 +168,11 @@ class TPUBackend:
         # ceil(B / max_batch_rows) jitted slices and concatenates.
         self.max_batch_rows = max(1, max_batch_rows)
         self.shared_context_scoring = bool(shared_context_scoring)
+        # Timing mode (VERDICT r2 #4): pin every generation to its full
+        # max_tokens budget (no EOS early-exit, no stop-string truncation)
+        # so random-weight timing runs can't flatter themselves with 1-token
+        # degenerate statements.  Never use for quality runs.
+        self.pin_generation_budget = bool(pin_generation_budget)
 
         if quantization not in (None, "none", "int8"):
             raise ValueError(f"unknown quantization mode: {quantization!r}")
@@ -249,6 +255,13 @@ class TPUBackend:
 
         self._bias_id_cache: Dict[str, Tuple[int, ...]] = {}
         self.call_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+        # Token-honest accounting (VERDICT r2 #4): "generated" counts
+        # statement tokens actually emitted (what the API baseline bills as
+        # output); "scored" counts teacher-forced positions whose logprob a
+        # caller consumed (continuation tokens, next-token proposals,
+        # session candidate x agent evaluations).  Cell-level deltas land in
+        # each run dir's token_counts.json (experiment.py).
+        self.token_counts = {"generated": 0, "scored": 0}
         self._unseeded_calls = 0
         # Guards the unseeded-call nonce: concurrent sweep cells opening
         # sessions/batches must never derive the same "fresh" stream.
@@ -302,6 +315,15 @@ class TPUBackend:
         shared so HBM allowances are computed from the allocated width."""
         longest = min(max(len(t) for t in token_lists), self.max_context)
         return min(_width_bucket(longest), self.max_context)
+
+    def _shared_cont_width(self, max_cont: int) -> int:
+        """Continuation-width bucket used by _score_shared_group — a coarse
+        pow2 ladder from 64 (fresh remote-AOT compile per variant, so the
+        variant space stays small), capped at the context window."""
+        width = 64
+        while width < max_cont:
+            width *= 2
+        return min(width, self.max_context)
 
     def _place_batch(self, *arrays):
         """Commit batch-leading arrays to the mesh, rows sharded over
@@ -492,6 +514,11 @@ class TPUBackend:
         keys = self._row_keys(
             "generate", [r.seed for r in requests] + [0] * pad_rows
         )
+        # Pinned-budget timing mode: an id no tokenizer emits (-1) disables
+        # the EOS early-exit, so the decode always runs the full window.
+        eos_ids = (
+            (-1,) if self.pin_generation_budget else self.tokenizer.eos_ids
+        )
         out = generate_tokens(
             self.params,
             self.config,
@@ -500,7 +527,7 @@ class TPUBackend:
             keys,
             max_new_tokens=max_new,
             temperature=temperatures,
-            eos_ids=jnp.asarray(self.tokenizer.eos_ids, jnp.int32),
+            eos_ids=jnp.asarray(eos_ids, jnp.int32),
             bias_table=bias_table,
             bias_index=bias_index,
             pad_id=self.tokenizer.pad_id,
@@ -520,16 +547,18 @@ class TPUBackend:
             # even though the bucketed decode window saw an EOS later.
             finish = "stop" if (hit_eos[row] and emitted <= request.max_tokens) else "length"
             truncated = False
-            for stop in request.stop:
-                idx = text.find(stop)
-                if idx >= 0:
-                    text = text[:idx]
-                    finish = "stop"
-                    truncated = True
+            if not self.pin_generation_budget:
+                for stop in request.stop:
+                    idx = text.find(stop)
+                    if idx >= 0:
+                        text = text[:idx]
+                        finish = "stop"
+                        truncated = True
             if truncated:
                 # Keep token_ids consistent with the truncated text so token
                 # counts/ids downstream match what the caller sees.
                 ids = self.tokenizer.encode(text)
+            self.token_counts["generated"] += len(ids)
             results.append(
                 GenerationResult(text=text, token_ids=tuple(ids), finish_reason=finish)
             )
@@ -572,12 +601,18 @@ class TPUBackend:
         if not self.shared_context_scoring:
             return self._sliced(requests, self._score_impl)
         prepared = []
+        # Memoize the prefix encoding: a P-candidate group shares one
+        # identical ~1k-token context — the workload this path dedupes —
+        # so tokenize it once, not P times (ADVICE r2).
+        prefix_ids: Dict[str, List[int]] = {}
         for request in requests:
             prefix = self._score_prefix(request)
+            if prefix not in prefix_ids:
+                prefix_ids[prefix] = self.tokenizer.encode(prefix, add_bos=True)
             prepared.append(
                 (
                     prefix,
-                    self.tokenizer.encode(prefix, add_bos=True),
+                    prefix_ids[prefix],
                     self.tokenizer.encode(request.continuation),
                 )
             )
@@ -593,10 +628,15 @@ class TPUBackend:
             max_cont = max((len(c) for c in conts), default=0)
             # The suffix attention materializes per-layer fp32 logits of
             # (rows, heads, span, ctx+span) — unlike the classic path it has
-            # no flash kernel, so bound that transient explicitly.
+            # no flash kernel, so bound that transient explicitly, and from
+            # the widths _score_shared_group will actually ALLOCATE (pow2
+            # continuation bucket, {1,1.5}-pow2 context bucket — up to ~2x
+            # the unpadded sizes the guard previously used, ADVICE r2).
+            cont_width = self._shared_cont_width(max_cont)
+            ctx_width = min(_width_bucket(len(ctx_ids)), self.max_context)
             attn_bytes = (
                 self.max_batch_rows * self.config.n_heads
-                * max_cont * (len(ctx_ids) + max_cont) * 4
+                * cont_width * (ctx_width + cont_width) * 4
             )
             fits = (
                 # >=4 rows: below that the single-row prefill + padded
@@ -643,10 +683,7 @@ class TPUBackend:
         # max_batch_rows bucket (padded suffix rows are cheap — the prefill
         # dominates), and continuation width uses a coarse pow2 ladder.
         n_rows = self.max_batch_rows
-        width = 64
-        while width < max(len(c) for c in conts):
-            width *= 2
-        width = min(width, self.max_context)
+        width = self._shared_cont_width(max(len(c) for c in conts))
         ctx_width = min(_width_bucket(len(ctx_ids)), self.max_context)
         pad = self.tokenizer.pad_id
         ctx_tokens = np.full((1, ctx_width), pad, np.int32)
@@ -671,6 +708,7 @@ class TPUBackend:
         )
         for row, i in enumerate(idxs):
             ids = conts[row]
+            self.token_counts["scored"] += len(ids)
             results[i] = ScoreResult(
                 tokens=tuple(self.tokenizer.token_str(t) for t in ids),
                 logprobs=tuple(float(v) for v in logprobs[row, : len(ids)]),
@@ -749,6 +787,7 @@ class TPUBackend:
             end = min(ctx_len + cont_len, width)
             span_lp = logprobs[i, ctx_len:end]
             span_ids = tokens[i, ctx_len:end]
+            self.token_counts["scored"] += len(span_lp)
             results.append(
                 ScoreResult(
                     tokens=tuple(self.tokenizer.token_str(t) for t in span_ids),
@@ -770,6 +809,7 @@ class TPUBackend:
         self.call_counts["next_token"] += len(requests)
         if not requests:
             return []
+        self.token_counts["scored"] += len(requests)
 
         token_lists = [
             self.tokenizer.encode(self._render_prompt(r), add_bos=True)
@@ -984,6 +1024,10 @@ class TPUTokenSearchSession:
 
         self._check_open()
         spec = self.spec
+        # k candidates x (n_roles - 1) agent evaluations per slot.
+        self.backend.token_counts["scored"] += (
+            spec.n_slots * spec.k * (self.n_roles - 1)
+        )
         out = search_prefill(
             self.backend.params, self.backend.config,
             self._tokens, self._valid,
@@ -1009,6 +1053,10 @@ class TPUTokenSearchSession:
         if self._step >= spec.max_steps:
             raise ValueError(f"session exhausted its {spec.max_steps} steps")
         self._step += 1
+        self.backend.token_counts["generated"] += spec.n_slots
+        self.backend.token_counts["scored"] += (
+            spec.n_slots * spec.k * (self.n_roles - 1)
+        )
         # One packed H2D array and one packed D2H fetch per step: every
         # host<->device round-trip rides a tunneled relay (~90 ms RTT), so
         # scalar-by-scalar shipping would dominate the whole search.
@@ -1052,6 +1100,11 @@ class TPUTokenSearchSession:
             raise ValueError("suffixes must share one non-zero length")
         # Pad the path count to a bucket (repeating row 0) so XLA reuses a
         # small set of compiled (P, L) shapes across tree levels.
+        # Each path re-evaluates its span under every agent and proposes k
+        # scored candidates.
+        self.backend.token_counts["scored"] += (
+            len(suffixes) * (span + spec.k) * (self.n_roles - 1)
+        )
         n_paths = _bucket(len(suffixes), minimum=4)
         tokens = np.zeros((n_paths, span), np.int32)
         for i, suffix in enumerate(suffixes):
@@ -1103,6 +1156,8 @@ class TPUTokenSearchSession:
         counted = rows[:, 1] > 0.5
         tok = self.backend.tokenizer
         ids = [int(rows[t, 0]) for t in range(depth) if counted[t]]
+        self.backend.token_counts["generated"] += len(ids)
+        self.backend.token_counts["scored"] += len(ids) * (self.n_roles - 1)
         text = "".join(tok.token_str(i) for i in ids)
         totals = [float(v) for v in rows[counted, 2:].sum(axis=0)]
         return ids, text, totals, True

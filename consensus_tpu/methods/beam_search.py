@@ -89,6 +89,9 @@ class BeamSearchGenerator(BaseGenerator):
         bias_tokens = tuple(cfg.get("bias_against_tokens", BIAS_AGAINST_TOKENS))
         bias_tokens += tuple(cfg.get("additional_bias_tokens", ()))
         bias_value = float(cfg.get("bias_value", -1_000_000))
+        # Timing mode (experiment timing_pin_budget): no EOS string may
+        # complete a beam early — every beam runs all max_tokens steps.
+        eos_tokens = frozenset() if cfg.get("pin_budget") else EOS_TOKENS
         seed = self.seed
 
         agents = list(agent_opinions.items())
@@ -134,7 +137,9 @@ class BeamSearchGenerator(BaseGenerator):
                         candidates.append(
                             (sequence + cand.token, new_rewards, cand, slot)
                         )
-                beams, completed = self._prune(candidates, completed, beam_width)
+                beams, completed = self._prune(
+                    candidates, completed, beam_width, eos_tokens
+                )
                 if not beams or step == max_tokens - 1:
                     break
                 # Advance every session slot; slots beyond the surviving
@@ -174,6 +179,7 @@ class BeamSearchGenerator(BaseGenerator):
         candidates: List[Tuple[str, List[float], ScoredCandidate, int]],
         completed: List[Tuple[str, List[float]]],
         beam_width: int,
+        eos_tokens: frozenset = EOS_TOKENS,
     ):
         """Egalitarian ranking; EOS tokens complete; dedup; keep top beams
         (reference :557-602).  Survivors keep (candidate, parent slot) so the
@@ -185,7 +191,7 @@ class BeamSearchGenerator(BaseGenerator):
         ):
             if sequence in seen:
                 continue
-            if cand.token in EOS_TOKENS:
+            if cand.token in eos_tokens:
                 completed.append((sequence, rewards))
             elif len(new_beams) < beam_width:
                 new_beams.append((sequence, rewards, cand, parent))

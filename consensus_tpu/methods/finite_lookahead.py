@@ -66,6 +66,11 @@ class FiniteLookaheadGenerator(BaseGenerator):
         max_tokens = int(cfg.get("max_tokens", 50))
         temperature = float(cfg.get("temperature", 1.0))
         seed = self.seed
+        # Timing mode (experiment timing_pin_budget): no terminator may end
+        # the statement or a path early — the tree runs its full budget.
+        terminators = (
+            frozenset() if cfg.get("pin_budget") else TERMINATOR_TOKENS
+        )
 
         agents = list(agent_opinions.items())
         if not agents:
@@ -100,12 +105,13 @@ class FiniteLookaheadGenerator(BaseGenerator):
             root_proposals = session.propose()[0]
             for step in range(max_tokens):
                 best = self._best_path(
-                    session, root_proposals, branching, max_depth, step
+                    session, root_proposals, branching, max_depth, step,
+                    terminators,
                 )
                 if best is None:
                     break
                 first = best[0][0]
-                if first.token in TERMINATOR_TOKENS:
+                if first.token in terminators:
                     break
                 statement += first.token
                 if step == max_tokens - 1:
@@ -126,6 +132,7 @@ class FiniteLookaheadGenerator(BaseGenerator):
     def _best_path(
         session, root_proposals: List[ScoredCandidate], branching: int,
         max_depth: int, step: int,
+        terminators: frozenset = TERMINATOR_TOKENS,
     ):
         """Grow the level-batched tree from the trunk, accumulate per-agent
         logprob sums along every path, and return the max-min mean path
@@ -134,7 +141,7 @@ class FiniteLookaheadGenerator(BaseGenerator):
         finished: List[Path] = []
         for cand in root_proposals[:branching]:
             node: Path = ([cand], list(cand.agent_logprobs))
-            if cand.token in TERMINATOR_TOKENS:
+            if cand.token in terminators:
                 finished.append(node)
             else:
                 frontier.append(node)
@@ -152,7 +159,7 @@ class FiniteLookaheadGenerator(BaseGenerator):
                         path + [cand],
                         [s + lp for s, lp in zip(sums, cand.agent_logprobs)],
                     )
-                    if cand.token in TERMINATOR_TOKENS:
+                    if cand.token in terminators:
                         finished.append(node)
                     else:
                         next_frontier.append(node)

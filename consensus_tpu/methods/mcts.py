@@ -62,7 +62,12 @@ class Node:
         "is_terminal",
     )
 
-    def __init__(self, cand: Optional[ScoredCandidate], parent: Optional["Node"]):
+    def __init__(
+        self,
+        cand: Optional[ScoredCandidate],
+        parent: Optional["Node"],
+        eos_tokens: frozenset = EOS_TOKENS,
+    ):
         self.cand = cand
         self.parent = parent
         self.children: Dict[str, Node] = {}
@@ -70,7 +75,7 @@ class Node:
         self.total_reward = 0.0
         self.immediate_reward = 0.0
         self.untried: Optional[List[ScoredCandidate]] = None
-        self.is_terminal = cand.token in EOS_TOKENS if cand is not None else False
+        self.is_terminal = cand.token in eos_tokens if cand is not None else False
 
     @property
     def value(self) -> float:
@@ -99,6 +104,10 @@ class MCTSGenerator(BaseGenerator):
         self._c = float(cfg.get("exploration_constant", 1.414))
         max_tokens = int(cfg.get("max_tokens", 100))
         self._width = int(cfg.get("expansion_sample_width", 5))
+        # Timing mode (experiment timing_pin_budget): no node is terminal.
+        self._eos_tokens = (
+            frozenset() if cfg.get("pin_budget") else EOS_TOKENS
+        )
         self._rollout_depth = int(cfg.get("rollout_depth", 10))
         self._gamma = float(cfg.get("gamma", 0.99))
         temperature = float(cfg.get("temperature", 1.0))
@@ -214,7 +223,7 @@ class MCTSGenerator(BaseGenerator):
         if not node.untried:
             return None
         candidate = node.untried.pop(0)
-        child = Node(candidate, node)
+        child = Node(candidate, node, self._eos_tokens)
         node.children[candidate.token] = child
 
         # Egalitarian immediate reward: min over agents of the new token's

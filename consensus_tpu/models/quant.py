@@ -106,13 +106,15 @@ def matmul(x: jax.Array, w) -> jax.Array:
     ``w.scale`` (1, d_out), broadcasting over rows.
     """
     if isinstance(w, QTensor):
-        if w.scale.shape[-2] != 1:
+        if w.q.ndim != 2 or w.scale.shape[-2] != 1:
             # Per-row-scaled (V, 1) tables (embed/lm_head) must go through
             # take_rows/slice_rows/project_logits — broadcasting their
-            # scales over output columns would be silently wrong.
+            # scales over output columns would be silently wrong.  Stacked
+            # unsliced layer tensors (n_layers, d_in, d_out) must be sliced
+            # first — _qdot would contract the LAYER axis (ADVICE r2).
             raise ValueError(
-                f"matmul expects per-output-channel scales (..., 1, d_out); "
-                f"got scale shape {w.scale.shape}"
+                f"matmul expects a 2-D weight slice with per-output-channel "
+                f"scales (1, d_out); got q {w.q.shape}, scale {w.scale.shape}"
             )
         y = _qdot(x, w.q, 0)
         return (y * w.scale.reshape((1,) * (y.ndim - 1) + (-1,))).astype(x.dtype)

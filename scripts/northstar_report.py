@@ -49,6 +49,12 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
             "wall_s": float(seconds),
             "run_dir": run_dir,
         }
+        tokens_json = pathlib.Path(run_dir) / "token_counts.json"
+        if tokens_json.exists():
+            # Token-honest columns (VERDICT r2 #4): tokens actually
+            # generated/scored, so s/stmt can't be flattered by degenerate
+            # short statements.
+            entry["tokens"] = json.loads(tokens_json.read_text())
         results_csv = pathlib.Path(run_dir) / "results.csv"
         if results_csv.exists():
             df = pd.read_csv(results_csv)
@@ -177,16 +183,25 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
         "`wall s / statements`, compared against the statement-weighted "
         "API baseline of the methods in the cell.",
         "",
-        "| config | wall s | statements | methods | cell s/stmt | weighted API s/stmt | speedup |",
-        "|---|---|---|---|---|---|---|",
+        "| config | wall s | statements | methods | cell s/stmt | tok gen | tok scored | s/1k tok | weighted API s/stmt | speedup |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for row in rows:
         statements = row.get("statements") or 0
         methods = row.get("methods", {})
+        tokens = row.get("tokens") or {}
+        tok_gen = tokens.get("tokens_generated")
+        tok_scored = tokens.get("tokens_scored")
+        s_per_1k = tokens.get("s_per_1k_tokens")
+        tok_cols = (
+            f"| {tok_gen} | {tok_scored} | {s_per_1k} "
+            if tok_gen is not None
+            else "| - | - | - "
+        )
         if not statements or not methods:
             lines.append(
                 f"| {row['config'].split('configs/')[-1]} | {row['wall_s']:.0f} "
-                f"| {statements or '?'} | - | - | - | - |"
+                f"| {statements or '?'} | - | - {tok_cols}| - | - |"
             )
             continue
         cell = row["wall_s"] / statements
@@ -211,7 +226,7 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
         lines.append(
             f"| {row['config'].split('configs/')[-1]} | {row['wall_s']:.0f} "
             f"| {statements} | {breakdown} | {cell:.2f} "
-            f"| {base_cell} | {speedup} |"
+            f"{tok_cols}| {base_cell} | {speedup} |"
         )
     (out / "northstar_timing.md").write_text("\n".join(lines) + "\n")
     print(json.dumps({k: report[k] for k in (
