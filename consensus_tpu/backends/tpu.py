@@ -68,6 +68,10 @@ _STREAMED_VOCAB_THRESHOLD = 32_768
 #: kernel, so oversized groups fall back to the classic (flash) path.
 _SHARED_SCORE_ATTN_BYTES_CAP = 1 << 31  # 2 GB
 
+#: Below this many identical-prompt rows the shared-trunk generate path
+#: isn't worth its own (1-row prefill + B-tail decode) program variant.
+_SHARED_TRUNK_MIN_ROWS = 4
+
 #: Search-session KV caches above this (plus resident weights) risk HBM
 #: exhaustion — fall back to the cacheless full-prefix session instead.
 _SESSION_CACHE_BYTES_CAP = 8 * 1024**3
@@ -142,6 +146,7 @@ class TPUBackend:
         max_batch_rows: int = 64,
         quantization: Optional[str] = None,
         shared_context_scoring: bool = False,
+        shared_trunk_generation: bool = True,
         pin_generation_budget: bool = False,
     ):
         self.config = config if config is not None else get_model_config(model)
@@ -168,6 +173,7 @@ class TPUBackend:
         # ceil(B / max_batch_rows) jitted slices and concatenates.
         self.max_batch_rows = max(1, max_batch_rows)
         self.shared_context_scoring = bool(shared_context_scoring)
+        self.shared_trunk_generation = bool(shared_trunk_generation)
         # Timing mode (VERDICT r2 #4): pin every generation to its full
         # max_tokens budget (no EOS early-exit, no stop-string truncation)
         # so random-weight timing runs can't flatter themselves with 1-token
@@ -464,6 +470,10 @@ class TPUBackend:
         requests: Sequence[GenerationRequest],
         token_lists: Optional[List[List[int]]] = None,
     ) -> List[GenerationResult]:
+        """Route: groups of >=_SHARED_TRUNK_MIN_ROWS identical prompts take
+        the shared-trunk decode (prefill once, per-step KV reads drop from
+        B·(ctx+t) to ctx+B·t — the shape of best_of_n's N drafts and every
+        habermas phase); everything else takes the classic per-row path."""
         if not requests:
             return []
 
@@ -472,6 +482,126 @@ class TPUBackend:
                 self.tokenizer.encode(self._render_prompt(r), add_bos=True)
                 for r in requests
             ]
+        if self.shared_trunk_generation:
+            groups: Dict[Tuple[int, ...], List[int]] = {}
+            for i, ids in enumerate(token_lists):
+                groups.setdefault(tuple(ids), []).append(i)
+
+            def takes_shared_path(ids_t, idxs) -> bool:
+                return len(idxs) >= _SHARED_TRUNK_MIN_ROWS and bool(ids_t)
+
+            if any(takes_shared_path(t, i) for t, i in groups.items()):
+                results: List[Optional[GenerationResult]] = [None] * len(requests)
+                classic: List[int] = []
+                for ids_t, idxs in groups.items():
+                    if takes_shared_path(ids_t, idxs):
+                        sub = self._generate_shared(
+                            [requests[i] for i in idxs], list(ids_t)
+                        )
+                        for i, result in zip(idxs, sub):
+                            results[i] = result
+                    else:
+                        classic.extend(idxs)
+                if classic:
+                    sub = self._generate_classic(
+                        [requests[i] for i in classic],
+                        [token_lists[i] for i in classic],
+                    )
+                    for i, result in zip(classic, sub):
+                        results[i] = result
+                return results  # type: ignore[return-value]
+        return self._generate_classic(requests, token_lists)
+
+    def _prep_generation_rows(self, requests: Sequence[GenerationRequest], allowed: int):
+        """Row bucketing + per-row sampling state shared by the classic and
+        shared-trunk generate paths (they MUST stay in lockstep — a pad-row
+        or eos-sentinel fix must hit both).
+
+        Rows pad to a power-of-two bucket so XLA compiles a small, reused
+        set of programs (decoders hand over varying candidate counts every
+        step); dummy rows are never read.  The pad floor respects the HBM
+        row allowance; dp-rounding keeps targets shardable.  The pinned-
+        budget eos sentinel (-1: an id no tokenizer emits) disables the EOS
+        early-exit in timing mode.
+        """
+        target = min(_bucket(len(requests), minimum=min(8, allowed)), allowed)
+        if target % self._dp:  # dp > 8: pow-of-two buckets may undershoot
+            target = min(-(-target // self._dp) * self._dp, allowed)
+        pad_rows = target - len(requests)
+        temperatures = jnp.asarray(
+            [r.temperature for r in requests] + [1.0] * pad_rows, jnp.float32
+        )
+        bias_table, bias_index = self._bias_table(requests)
+        if bias_index is not None and pad_rows:
+            bias_index = jnp.concatenate(
+                [bias_index, jnp.zeros((pad_rows,), jnp.int32)]
+            )
+        keys = self._row_keys(
+            "generate", [r.seed for r in requests] + [0] * pad_rows
+        )
+        eos_ids = (
+            (-1,) if self.pin_generation_budget else self.tokenizer.eos_ids
+        )
+        return target, pad_rows, temperatures, bias_table, bias_index, keys, eos_ids
+
+    def _generate_shared(
+        self, requests: Sequence[GenerationRequest], prompt_ids: List[int]
+    ) -> List[GenerationResult]:
+        """Decode all rows from ONE shared prompt trunk
+        (models/generate.py:generate_tokens_shared_trunk)."""
+        from consensus_tpu.models.generate import generate_tokens_shared_trunk
+
+        max_new = _width_bucket(max(r.max_tokens for r in requests), minimum=16)
+        width = min(_width_bucket(len(prompt_ids)), self.max_context)
+        prompt_ids = prompt_ids[-width:]
+        # Tail-only per-row HBM (the trunk is one row, a closure constant):
+        # rows are ~(ctx+2·max_new)/(2·max_new) times cheaper than classic.
+        allowed = self._generate_rows_allowed(0, max_new)
+        if len(requests) > allowed:
+            out: List[GenerationResult] = []
+            for i in range(0, len(requests), allowed):
+                out.extend(
+                    self._generate_shared(requests[i : i + allowed], prompt_ids)
+                )
+            return out
+
+        self.call_counts["generate"] += len(requests)
+        target, pad_rows, temperatures, bias_table, bias_index, keys, eos_ids = (
+            self._prep_generation_rows(requests, allowed)
+        )
+
+        pad = self.tokenizer.pad_id
+        tokens = np.full((1, width), pad, np.int32)
+        valid = np.zeros((1, width), bool)
+        tokens[0, width - len(prompt_ids):] = prompt_ids
+        valid[0, width - len(prompt_ids):] = True
+
+        # Bucket-pad rows start done (they'd otherwise sample real tokens
+        # from the real prompt and pin the early exit at the full budget).
+        init_done = np.zeros((target,), bool)
+        init_done[len(requests):] = True
+        out = generate_tokens_shared_trunk(
+            self.params,
+            self.config,
+            jnp.asarray(tokens),
+            jnp.asarray(valid),
+            target,
+            keys,
+            max_new_tokens=max_new,
+            temperature=temperatures,
+            eos_ids=jnp.asarray(eos_ids, jnp.int32),
+            bias_table=bias_table,
+            bias_index=bias_index,
+            pad_id=self.tokenizer.pad_id,
+            init_done=jnp.asarray(init_done),
+        )
+        return self._finish_generation(requests, out)
+
+    def _generate_classic(
+        self,
+        requests: Sequence[GenerationRequest],
+        token_lists: List[List[int]],
+    ) -> List[GenerationResult]:
         width = self._batch_width(token_lists)
         max_new = _width_bucket(max(r.max_tokens for r in requests), minimum=16)
         allowed = self._generate_rows_allowed(width, max_new)
@@ -483,7 +613,7 @@ class TPUBackend:
             out: List[GenerationResult] = []
             for i in range(0, len(requests), allowed):
                 out.extend(
-                    self._generate_impl(
+                    self._generate_classic(
                         requests[i : i + allowed],
                         token_lists[i : i + allowed],
                     )
@@ -491,34 +621,11 @@ class TPUBackend:
             return out
 
         self.call_counts["generate"] += len(requests)
-        # Row bucketing: pad the batch to a power-of-two row count so XLA
-        # compiles a small, reused set of programs (decoders hand over
-        # varying candidate counts every step).  Dummy rows are all-invalid
-        # and their outputs are never read.  The pad floor respects the HBM
-        # row allowance (a floor of 8 with 2 allowed would defeat it).
-        target = min(_bucket(len(requests), minimum=min(8, allowed)), allowed)
-        if target % self._dp:  # dp > 8: pow-of-two buckets may undershoot
-            target = min(-(-target // self._dp) * self._dp, allowed)
-        pad_rows = target - len(requests)
+        target, pad_rows, temperatures, bias_table, bias_index, keys, eos_ids = (
+            self._prep_generation_rows(requests, allowed)
+        )
         token_lists = list(token_lists) + [[]] * pad_rows
         tokens, valid = self._left_pad_batch(token_lists)
-        temperatures = jnp.asarray(
-            [r.temperature for r in requests] + [1.0] * pad_rows, jnp.float32
-        )
-
-        bias_table, bias_index = self._bias_table(requests)
-        if bias_index is not None and pad_rows:
-            bias_index = jnp.concatenate(
-                [bias_index, jnp.zeros((pad_rows,), jnp.int32)]
-            )
-        keys = self._row_keys(
-            "generate", [r.seed for r in requests] + [0] * pad_rows
-        )
-        # Pinned-budget timing mode: an id no tokenizer emits (-1) disables
-        # the EOS early-exit, so the decode always runs the full window.
-        eos_ids = (
-            (-1,) if self.pin_generation_budget else self.tokenizer.eos_ids
-        )
         out = generate_tokens(
             self.params,
             self.config,
@@ -532,6 +639,13 @@ class TPUBackend:
             bias_index=bias_index,
             pad_id=self.tokenizer.pad_id,
         )
+        return self._finish_generation(requests, out)
+
+    def _finish_generation(
+        self, requests: Sequence[GenerationRequest], out
+    ) -> List[GenerationResult]:
+        """Shared host-side post-processing: decode, EOS/stop semantics,
+        token accounting."""
         generated = np.asarray(out.tokens)
         counts = np.asarray(out.num_generated)
         hit_eos = np.asarray(out.hit_eos)
@@ -650,12 +764,18 @@ class TPUBackend:
             if not fits:
                 legacy.extend(idxs)
                 continue
+            # Prefill the shared context ONCE for the whole group; every
+            # row chunk scores against the same resident trunk (round 2
+            # re-prefilled per 32-row chunk — VERDICT r2 #5).
+            trunk_state = None
             for start in range(0, len(idxs), self.max_batch_rows):
                 chunk = idxs[start : start + self.max_batch_rows]
                 if len(chunk) < 4:  # sub-threshold tail: ride the wide batch
                     legacy.extend(chunk)
                     continue
-                self._score_shared_group(ctx_ids, chunk, prepared, results)
+                if trunk_state is None:
+                    trunk_state = self._shared_prefill(ctx_ids)
+                self._score_shared_group(trunk_state, chunk, prepared, results)
         if legacy:
             for start in range(0, len(legacy), self.max_batch_rows):
                 chunk = legacy[start : start + self.max_batch_rows]
@@ -667,14 +787,28 @@ class TPUBackend:
                     results[i] = result
         return results  # type: ignore[return-value]
 
+    def _shared_prefill(self, ctx_ids: List[int]):
+        """Prefill one shared scoring context into a resident trunk."""
+        from consensus_tpu.models.transformer import shared_context_prefill
+
+        ctx_width = min(_width_bucket(len(ctx_ids)), self.max_context)
+        pad = self.tokenizer.pad_id
+        ctx_tokens = np.full((1, ctx_width), pad, np.int32)
+        ctx_tokens[0, : len(ctx_ids)] = ctx_ids
+        ctx_valid = np.zeros((1, ctx_width), bool)
+        ctx_valid[0, : len(ctx_ids)] = True
+        return shared_context_prefill(
+            self.params, self.config, jnp.asarray(ctx_tokens), jnp.asarray(ctx_valid)
+        )
+
     def _score_shared_group(
         self,
-        ctx_ids: List[int],
+        trunk_state,
         idxs: List[int],
         prepared,
         results,
     ) -> None:
-        from consensus_tpu.models.transformer import shared_context_token_logprobs
+        from consensus_tpu.models.transformer import shared_context_cont_logprobs
 
         self.call_counts["score"] += len(idxs)
         conts = [prepared[i][2] for i in idxs]
@@ -684,24 +818,21 @@ class TPUBackend:
         # dominates), and continuation width uses a coarse pow2 ladder.
         n_rows = self.max_batch_rows
         width = self._shared_cont_width(max(len(c) for c in conts))
-        ctx_width = min(_width_bucket(len(ctx_ids)), self.max_context)
         pad = self.tokenizer.pad_id
-        ctx_tokens = np.full((1, ctx_width), pad, np.int32)
-        ctx_tokens[0, : len(ctx_ids)] = ctx_ids
-        ctx_valid = np.zeros((1, ctx_width), bool)
-        ctx_valid[0, : len(ctx_ids)] = True
         cont_tokens = np.full((n_rows, width), pad, np.int32)
         cont_valid = np.zeros((n_rows, width), bool)
         for row, ids in enumerate(conts):
             cont_tokens[row, : len(ids)] = ids
             cont_valid[row, : len(ids)] = True
         cont_tokens_dev, cont_valid_dev = self._place_batch(cont_tokens, cont_valid)
+        trunk, ctx_len, last_hidden = trunk_state
         logprobs = np.asarray(
-            shared_context_token_logprobs(
+            shared_context_cont_logprobs(
                 self.params,
                 self.config,
-                jnp.asarray(ctx_tokens),
-                jnp.asarray(ctx_valid),
+                trunk,
+                ctx_len,
+                last_hidden,
                 cont_tokens_dev,
                 cont_valid_dev,
             )

@@ -161,6 +161,129 @@ def generate_tokens(
     return GenerateOutput(tokens=tokens, num_generated=num_generated, hit_eos=hit_eos)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "batch", "max_new_tokens", "top_k", "top_p", "pad_id"),
+)
+def generate_tokens_shared_trunk(
+    params,
+    config: ModelConfig,
+    prompt_tokens: jax.Array,  # (1, S_ctx) int32 — ONE shared prompt
+    prompt_valid: jax.Array,  # (1, S_ctx) bool
+    batch: int,  # rows to decode from the shared prompt
+    key: jax.Array,  # (B, 2) per-row PRNG keys
+    max_new_tokens: int,
+    temperature: float | jax.Array = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_ids: Optional[jax.Array] = None,
+    bias_table: Optional[jax.Array] = None,
+    bias_index: Optional[jax.Array] = None,
+    pad_id: int = 0,
+    init_done: Optional[jax.Array] = None,  # (B,) bool — bucket-pad rows
+) -> GenerateOutput:
+    """``generate_tokens`` for B rows sharing ONE identical prompt.
+
+    The workloads that dominate the sweep decode many rows from the same
+    prompt: best_of_n's N drafts share the reference prompt
+    (/root/reference/src/methods/best_of_n.py:101-142 — n calls, same
+    prompt, seeds seed+i) and every habermas phase reuses one prompt per
+    batch (habermas_machine.py:530-583).  The classic path prefills the
+    prompt B times and each decode step re-reads B full prompt KV caches —
+    at a 30-run cell's widths the per-step cache read is GBs and dominates
+    the statement time.  Here the prompt prefills ONCE into a 1-row trunk
+    and every decode row broadcast-attends it inside the attention einsum
+    (transformer.forward_trunk_tail with n_slots=B, n_roles=1): per-step
+    HBM traffic drops from B·(ctx+t) to ctx + B·t key/value rows, and
+    prefill compute drops B-fold.
+
+    Sampling semantics are identical to ``generate_tokens`` — per-row keys
+    drive distinct rows; logits are row-independent of batch composition.
+    """
+    c = config
+    s_ctx = prompt_tokens.shape[1]
+    if eos_ids is None:
+        eos_ids = jnp.zeros((0,), jnp.int32)
+    if bias_table is not None:
+        logit_bias = bias_table[bias_index]
+    else:
+        logit_bias = None
+
+    trunk = make_cache(config, 1, s_ctx, params["embed"].dtype)
+    positions = left_pad_positions(prompt_valid)
+    hidden, trunk = forward(
+        params, config, prompt_tokens, positions, prompt_valid, trunk, 0,
+        return_hidden=True,
+    )
+    # One logits row, broadcast to every decode row.
+    next_logits = jnp.broadcast_to(
+        project_logits(params, config, hidden[:, -1, :]), (batch,)
+        + (c.vocab_size,)
+    )
+    cur_pos = jnp.broadcast_to(positions[:, -1], (batch,))
+    tail_positions = cur_pos[:, None] + 1 + jnp.arange(max_new_tokens)[None, :]
+    tail_shape = (c.n_layers, batch, max_new_tokens, c.n_kv_heads, c.head_dim)
+    tail_k = jnp.zeros(tail_shape, params["embed"].dtype)
+    tail_v = jnp.zeros(tail_shape, params["embed"].dtype)
+
+    def is_eos(token: jax.Array) -> jax.Array:
+        if eos_ids.shape[0] == 0:
+            return jnp.zeros_like(token, dtype=jnp.bool_)
+        return jnp.any(token[:, None] == eos_ids[None, :], axis=-1)
+
+    tokens_buf = jnp.full((max_new_tokens, batch), pad_id, jnp.int32)
+    emitted_buf = jnp.zeros((max_new_tokens, batch), jnp.bool_)
+
+    def cond(carry):
+        i, _, _, _, done, _, _, _, _ = carry
+        return (i < max_new_tokens) & ~jnp.all(done)
+
+    def body(carry):
+        i, next_logits, tail_k, tail_v, done, key, cur_pos, tokens_buf, emitted_buf = carry
+        pairs = jax.vmap(jax.random.split)(key)
+        key, sub = pairs[:, 0], pairs[:, 1]
+        token = sample_tokens(
+            sub, next_logits, temperature=temperature, top_k=top_k, top_p=top_p,
+            logit_bias=logit_bias,
+        )
+        token = jnp.where(done, pad_id, token)
+        token_is_eos = is_eos(token) & ~done
+        emitted = ~done & ~token_is_eos
+        new_done = done | token_is_eos
+
+        pos = cur_pos + 1
+        # n_slots=batch, n_roles=1: every row broadcast-attends trunk row 0.
+        hidden, tail_k, tail_v = forward_trunk_tail(
+            params, config, token, pos, trunk, tail_k, tail_v,
+            tail_positions, i, batch, 1,
+        )
+        logits = project_logits(params, config, hidden)
+        tokens_buf = jax.lax.dynamic_update_slice(tokens_buf, token[None], (i, 0))
+        emitted_buf = jax.lax.dynamic_update_slice(
+            emitted_buf, emitted[None], (i, 0)
+        )
+        return (
+            i + 1, logits, tail_k, tail_v, new_done, key, pos,
+            tokens_buf, emitted_buf,
+        )
+
+    if init_done is None:
+        init_done = jnp.zeros((batch,), jnp.bool_)
+    init = (
+        jnp.asarray(0, jnp.int32), next_logits, tail_k, tail_v,
+        init_done, key, cur_pos, tokens_buf, emitted_buf,
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    tokens, emitted = final[7], final[8]
+
+    tokens = tokens.T
+    emitted = emitted.T
+    num_generated = jnp.sum(emitted.astype(jnp.int32), axis=1)
+    hit_eos = num_generated < max_new_tokens
+    tokens = jnp.where(emitted, tokens, pad_id)
+    return GenerateOutput(tokens=tokens, num_generated=num_generated, hit_eos=hit_eos)
+
+
 @functools.partial(jax.jit, static_argnames=("config", "k", "with_gumbel"))
 def next_token_topk(
     params,
