@@ -95,6 +95,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     eval_group = parser.add_argument_group("Evaluation Options")
     eval_group.add_argument(
+        "--embedding-model-path", dest="embedding_model_path", default=None,
+        help="local sentence-transformers dir for cosine metrics "
+        "(reference: BAAI/bge-large-en-v1.5); default: LM-pooled hiddens",
+    )
+    eval_group.add_argument(
         "--evaluation-model", default="",
         help="Label for the evaluation model (directory naming)",
     )
@@ -138,11 +143,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.judge_backend or "openai", model=args.llm_judge_model
         )
 
+    from consensus_tpu.embedding import get_embedder
+
     evaluator = StatementEvaluator(
         backend,
         evaluation_model=args.evaluation_model or args.model or "model",
         judge_backend=judge_backend,
         llm_judge_model=args.llm_judge_model,
+        embedder=get_embedder(getattr(args, "embedding_model_path", None), backend),
     )
 
     if args.results_file:

@@ -60,9 +60,14 @@ def score_statements_batched(
     statements: Sequence[str],
     issue: str,
     agent_opinions: Dict[str, str],
+    embedder=None,
 ) -> List[Dict[str, float]]:
     """Per-statement welfare metrics with ONE score batch and ONE embed batch
     across (statements × agents) — the TPU-shaped evaluation loop."""
+    if embedder is None:
+        from consensus_tpu.embedding import LMPoolEmbedder
+
+        embedder = LMPoolEmbedder(backend)
     agents = list(agent_opinions.items())
     requests = [
         ScoreRequest(
@@ -76,7 +81,7 @@ def score_statements_batched(
     ]
     results = backend.score(requests)
 
-    vectors = backend.embed(list(statements) + [op for _, op in agents])
+    vectors = embedder.embed(list(statements) + [op for _, op in agents])
     statement_vecs = vectors[: len(statements)]
     opinion_vecs = vectors[len(statements):]
 
@@ -108,8 +113,16 @@ def build_report(
     sweeps: Optional[Sequence[str]] = None,
     weights: str = "random",
     baseline: Optional[Dict[str, Any]] = None,
+    embedder=None,
 ) -> Dict[str, Any]:
     data = baseline if baseline is not None else load_baseline()
+    if embedder is None:
+        from consensus_tpu.embedding import LMPoolEmbedder
+
+        embedder = LMPoolEmbedder(backend)
+    # The reference embeds with BAAI/bge-large-en-v1.5 (src/utils.py:376-407);
+    # cosine-family numbers are baseline-comparable ONLY under that encoder.
+    cosine_comparable = "bge-large-en-v1.5" in embedder.name
     cells: List[Dict[str, Any]] = []
 
     for run in data["runs"]:
@@ -132,7 +145,7 @@ def build_report(
         flat_statements = [s for key in grouped for s in grouped[key]]
         start = time.perf_counter()
         flat_metrics = score_statements_batched(
-            backend, flat_statements, issue, opinions
+            backend, flat_statements, issue, opinions, embedder=embedder
         )
         elapsed = time.perf_counter() - start
 
@@ -178,6 +191,8 @@ def build_report(
         "backend": getattr(backend, "name", "unknown"),
         "model": getattr(backend, "model_name", ""),
         "weights": weights,
+        "embedder": embedder.name,
+        "cosine_baseline_comparable": cosine_comparable,
         "evaluator_baseline_key": evaluator_key,
         "n_cells": len(cells),
         "mean_abs_perplexity_delta_pct": (
@@ -198,7 +213,25 @@ def render_markdown(report: Dict[str, Any]) -> str:
         f"- Cells: {report['n_cells']}, within 1%: "
         f"{report['cells_within_1pct']}, mean |Δppl|: "
         f"{report['mean_abs_perplexity_delta_pct']}%",
+        f"- Embedder: `{report['embedder']}`",
         "",
+    ]
+    if not report.get("cosine_baseline_comparable"):
+        lines += [
+            "**Cosine-family metrics are NOT baseline-comparable in this "
+            "report.** The reference embeds with a dedicated encoder, "
+            "`BAAI/bge-large-en-v1.5` (src/utils.py:376-407); this run "
+            f"embedded with `{report['embedder']}` — a structurally "
+            "different embedding space. Local cosine numbers are "
+            "self-consistent (usable for method-vs-method comparisons "
+            "within this report) but are excluded from the within-1% "
+            "parity tally, which covers the perplexity family only. To "
+            "restore reference semantics, place a local copy of the bge "
+            "model on disk and pass `models.embedding_model_path` "
+            "(consensus_tpu/embedding.py).",
+            "",
+        ]
+    lines += [
         "| scenario | sweep | method | params | egal ppl (local) | egal ppl"
         " (baseline) | Δ% |",
         "|---|---|---|---|---|---|---|",
@@ -227,6 +260,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--evaluator-key", default=DEFAULT_BASELINE_EVALUATOR,
         help="which bundled baseline evaluator column to diff against",
     )
+    parser.add_argument(
+        "--embedding-model-path", default=None,
+        help="local sentence-transformers dir (reference: bge-large-en-v1.5)",
+    )
     parser.add_argument("--output", default="results/parity")
     args = parser.parse_args(argv)
 
@@ -246,8 +283,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         weights = "checkpoint" if args.checkpoint else "random"
 
+    from consensus_tpu.embedding import get_embedder
+
     report = build_report(
         backend,
+        embedder=get_embedder(args.embedding_model_path, backend),
         evaluator_key=args.evaluator_key,
         scenarios=args.scenario,
         sweeps=args.sweep,

@@ -45,9 +45,15 @@ def evaluate_run_dir(
         **(config.get("backend_options") or {}),
     )
     judge = backend if with_judge else None
+    from consensus_tpu.embedding import get_embedder
+
+    embedder = get_embedder(
+        (config.get("models") or {}).get("embedding_model_path"), backend
+    )
     for model in evaluation_models:
         evaluator = StatementEvaluator(
-            backend, evaluation_model=model, judge_backend=judge
+            backend, evaluation_model=model, judge_backend=judge,
+            embedder=embedder,
         )
         evaluator.evaluate_results_file(
             str(run_dir / "results.csv"), config=config,

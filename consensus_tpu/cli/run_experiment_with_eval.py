@@ -83,6 +83,14 @@ def run_pipeline(
         _judge_cache.append(judge)
         return judge
 
+    # Dedicated evaluation embedder when configured (models.embedding_model_path
+    # or EVAL_EMBEDDER env) — else LM-pooled hiddens (consensus_tpu.embedding).
+    from consensus_tpu.embedding import get_embedder
+
+    embedder = get_embedder(
+        (config.get("models") or {}).get("embedding_model_path"), backend
+    )
+
     # ---- Phase 2a: per-seed comparative ranking -----------------------
     if not skip_comparative_ranking:
         logger.info("=== Phase 2a: LLM-judge comparative ranking ===")
@@ -90,6 +98,7 @@ def run_pipeline(
             backend,
             judge_backend=judge_backend_lazy(),
             llm_judge_model=llm_judge_model,
+            embedder=embedder,
         )
         for seed_index, seed in enumerate(sorted(results["seed"].unique())):
             subset = results[
@@ -154,6 +163,10 @@ def run_pipeline(
             evaluation_model=model,
             judge_backend=judge_backend_lazy() if include_llm_judge else None,
             llm_judge_model=llm_judge_model,
+            embedder=get_embedder(
+                (config.get("models") or {}).get("embedding_model_path"),
+                model_backend,
+            ),
         )
         evaluator.evaluate_results_file(
             str(run_dir / "results.csv"),

@@ -72,11 +72,20 @@ class StatementEvaluator:
         evaluation_model: str = "",
         judge_backend: Optional[Backend] = None,
         llm_judge_model: str = "",
+        embedder: Optional[Any] = None,
     ):
         self.backend = backend
         self.evaluation_model = evaluation_model
         self.judge_backend = judge_backend
         self.llm_judge_model = llm_judge_model
+        # Cosine-family embeddings: a dedicated encoder when configured
+        # (reference uses BAAI/bge-large-en-v1.5, src/utils.py:376-407),
+        # else the generation LM's pooled hiddens (consensus_tpu.embedding).
+        if embedder is None:
+            from consensus_tpu.embedding import LMPoolEmbedder
+
+            embedder = LMPoolEmbedder(backend)
+        self.embedder = embedder
 
     # ------------------------------------------------------------------
     # Single-statement metrics
@@ -93,7 +102,7 @@ class StatementEvaluator:
         metrics: Dict[str, Any] = {}
 
         # -- cosine utilities (one embed batch) ---------------------------
-        vectors = self.backend.embed([statement] + [op for _, op in agents])
+        vectors = self.embedder.embed([statement] + [op for _, op in agents])
         statement_vec, opinion_vecs = vectors[0], vectors[1:]
         cosines = opinion_vecs @ statement_vec  # embeddings are unit-norm
         for (name, _), cos in zip(agents, cosines):

@@ -1,0 +1,137 @@
+"""Evaluation embedder wiring (VERDICT r2 #6).
+
+The reference embeds with BAAI/bge-large-en-v1.5
+(/root/reference/src/utils.py:376-407); this box has zero egress so no bge
+checkpoint exists — the default is LM-pooled hiddens and the parity report
+must flag cosine metrics as not baseline-comparable.  The
+sentence-transformers path is exercised against a tiny ST model BUILT
+LOCALLY (transformer module + mean pooling, saved/loaded offline), so the
+wiring is proven even though the real encoder isn't fetchable.
+"""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.embedding import LMPoolEmbedder, get_embedder
+
+
+def test_default_is_lm_pool():
+    backend = FakeBackend()
+    embedder = get_embedder(None, backend)
+    assert isinstance(embedder, LMPoolEmbedder)
+    assert embedder.name.startswith("lm-pool:")
+    vectors = embedder.embed(["a statement", "an opinion"])
+    assert vectors.shape[0] == 2
+
+
+def test_missing_dir_raises():
+    with pytest.raises(ValueError, match="not a directory"):
+        get_embedder("/nonexistent/bge-large-en-v1.5", FakeBackend())
+
+
+@pytest.fixture(scope="module")
+def tiny_st_dir(tmp_path_factory):
+    """Build a tiny sentence-transformers model fully offline: a tiny HF
+    BERT + mean pooling, saved in ST format."""
+    st = pytest.importorskip("sentence_transformers")
+    transformers = pytest.importorskip("transformers")
+    from tokenizers import Tokenizer, models as tok_models, pre_tokenizers, trainers
+
+    path = tmp_path_factory.mktemp("tiny_st")
+    hf_dir = path / "hf"
+    hf_dir.mkdir()
+
+    # Tiny BERT + a word-level tokenizer over a tiny corpus.
+    config = transformers.BertConfig(
+        vocab_size=200,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=64,
+    )
+    import torch
+
+    torch.manual_seed(0)
+    model = transformers.BertModel(config)
+    model.save_pretrained(str(hf_dir))
+
+    tok = Tokenizer(tok_models.WordPiece(unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.WordPieceTrainer(
+        vocab_size=200,
+        special_tokens=["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"],
+    )
+    tok.train_from_iterator(
+        ["the city should plant more trees", "car free weekends boost shops"],
+        trainer,
+    )
+    tok.save(str(hf_dir / "tokenizer.json"))
+    import json
+
+    (hf_dir / "tokenizer_config.json").write_text(
+        json.dumps(
+            {
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "pad_token": "[PAD]",
+                "unk_token": "[UNK]",
+                "cls_token": "[CLS]",
+                "sep_token": "[SEP]",
+                "model_max_length": 64,
+            }
+        )
+    )
+
+    from sentence_transformers import SentenceTransformer, models as st_models
+
+    word = st_models.Transformer(str(hf_dir), max_seq_length=32)
+    pooling = st_models.Pooling(
+        word.get_word_embedding_dimension(), pooling_mode="mean"
+    )
+    st_model = SentenceTransformer(modules=[word, pooling], device="cpu")
+    st_dir = path / "st_model"
+    st_model.save(str(st_dir))
+    return str(st_dir)
+
+
+def test_sentence_transformer_embedder_loads_and_embeds(tiny_st_dir):
+    embedder = get_embedder(tiny_st_dir, FakeBackend())
+    assert embedder.name.startswith("sentence-transformers:")
+    vectors = embedder.embed(["plant more trees", "car free weekends"])
+    assert vectors.shape == (2, 32)
+    np.testing.assert_allclose(
+        np.linalg.norm(vectors, axis=1), 1.0, atol=1e-5
+    )
+
+
+def test_evaluator_uses_configured_embedder(tiny_st_dir):
+    from consensus_tpu.evaluation import StatementEvaluator
+
+    backend = FakeBackend()
+    embedder = get_embedder(tiny_st_dir, backend)
+    evaluator = StatementEvaluator(backend, embedder=embedder)
+    metrics = evaluator.evaluate_statement(
+        "We will plant trees.", "Trees?", {"Agent 1": "yes", "Agent 2": "no"}
+    )
+    assert "egalitarian_welfare_cosine" in metrics
+    # The ST space differs from the LM-pool space: different embedder,
+    # different cosine numbers.
+    lm_metrics = StatementEvaluator(backend).evaluate_statement(
+        "We will plant trees.", "Trees?", {"Agent 1": "yes", "Agent 2": "no"}
+    )
+    assert (
+        metrics["egalitarian_welfare_cosine"]
+        != lm_metrics["egalitarian_welfare_cosine"]
+    )
+
+
+def test_parity_report_flags_cosine_incomparability():
+    from consensus_tpu.cli.parity_report import build_report, render_markdown
+
+    report = build_report(FakeBackend(), scenarios=[1], weights="fake")
+    assert report["cosine_baseline_comparable"] is False
+    assert report["embedder"].startswith("lm-pool:")
+    markdown = render_markdown(report)
+    assert "NOT baseline-comparable" in markdown
+    assert "bge-large-en-v1.5" in markdown
