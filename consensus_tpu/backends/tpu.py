@@ -297,15 +297,16 @@ class TPUBackend:
 
     # -- helpers -------------------------------------------------------------
 
-    def _sliced(self, requests, fn):
-        """Run ``fn`` over ``max_batch_rows``-sized slices and concatenate.
-        Safe because per-request PRNG keys make results independent of batch
-        composition."""
-        if len(requests) <= self.max_batch_rows:
+    def _sliced(self, requests, fn, limit: Optional[int] = None):
+        """Run ``fn`` over ``limit``-sized slices (default max_batch_rows)
+        and concatenate.  Safe because per-request PRNG keys make results
+        independent of batch composition."""
+        limit = limit or self.max_batch_rows
+        if len(requests) <= limit:
             return fn(requests)
         out = []
-        for i in range(0, len(requests), self.max_batch_rows):
-            out.extend(fn(requests[i : i + self.max_batch_rows]))
+        for i in range(0, len(requests), limit):
+            out.extend(fn(requests[i : i + limit]))
         return out
 
 
@@ -430,7 +431,15 @@ class TPUBackend:
     # -- generate ------------------------------------------------------------
 
     def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
-        return self._sliced(requests, self._generate_impl)
+        # The wide slice exists for the SHARED-TRUNK path: its prefill is 1
+        # row and its per-step state is (B, V) logits + the KV tail, so a
+        # co-batched sweep cell's hundreds of identical-prompt drafts ride
+        # ONE decode dispatch instead of ceil(B/32) sequential ones (each
+        # with its own tunneled-RTT + dispatch overhead).  The classic path
+        # re-caps itself at max_batch_rows (its B-row prefill still
+        # materializes per-layer (B, g, r, S, T) fp32 attention logits —
+        # the transient max_batch_rows exists to bound).
+        return self._sliced(requests, self._generate_impl, limit=256)
 
     def _generate_rows_allowed(self, prompt_width: int, max_new: int) -> int:
         """Largest decode batch whose KV cache fits HBM next to the weights.
@@ -602,6 +611,21 @@ class TPUBackend:
         requests: Sequence[GenerationRequest],
         token_lists: List[List[int]],
     ) -> List[GenerationResult]:
+        # Classic-path batches keep the max_batch_rows activation bound:
+        # the B-row prefill materializes per-layer (B, g, r, S, T) fp32
+        # attention logits that the KV-only HBM allowance below does not
+        # model (the generate() slice limit is wider only for the 1-row-
+        # prefill shared-trunk path).
+        if len(requests) > self.max_batch_rows:
+            out: List[GenerationResult] = []
+            for i in range(0, len(requests), self.max_batch_rows):
+                out.extend(
+                    self._generate_classic(
+                        requests[i : i + self.max_batch_rows],
+                        token_lists[i : i + self.max_batch_rows],
+                    )
+                )
+            return out
         width = self._batch_width(token_lists)
         max_new = _width_bucket(max(r.max_tokens for r in requests), minimum=16)
         allowed = self._generate_rows_allowed(width, max_new)
@@ -746,12 +770,21 @@ class TPUBackend:
             # the widths _score_shared_group will actually ALLOCATE (pow2
             # continuation bucket, {1,1.5}-pow2 context bucket — up to ~2x
             # the unpadded sizes the guard previously used, ADVICE r2).
+            # Chunk rows start at 4x max_batch_rows (suffix-only rows carry
+            # no (B, S, S) transient — a co-batched cell's 256-candidate
+            # group rides 2 dispatches instead of 8) and halve until the
+            # transient fits.
             cont_width = self._shared_cont_width(max_cont)
             ctx_width = min(_width_bucket(len(ctx_ids)), self.max_context)
-            attn_bytes = (
-                self.max_batch_rows * self.config.n_heads
-                * cont_width * (ctx_width + cont_width) * 4
-            )
+            rows_cap = max(self.max_batch_rows, 128)
+            while rows_cap >= 8:
+                attn_bytes = (
+                    rows_cap * self.config.n_heads
+                    * cont_width * (ctx_width + cont_width) * 4
+                )
+                if attn_bytes <= _SHARED_SCORE_ATTN_BYTES_CAP:
+                    break
+                rows_cap //= 2
             fits = (
                 # >=4 rows: below that the single-row prefill + padded
                 # suffix costs more than riding a wide legacy batch.
@@ -768,14 +801,14 @@ class TPUBackend:
             # row chunk scores against the same resident trunk (round 2
             # re-prefilled per 32-row chunk — VERDICT r2 #5).
             trunk_state = None
-            for start in range(0, len(idxs), self.max_batch_rows):
-                chunk = idxs[start : start + self.max_batch_rows]
+            for start in range(0, len(idxs), rows_cap):
+                chunk = idxs[start : start + rows_cap]
                 if len(chunk) < 4:  # sub-threshold tail: ride the wide batch
                     legacy.extend(chunk)
                     continue
                 if trunk_state is None:
                     trunk_state = self._shared_prefill(ctx_ids)
-                self._score_shared_group(trunk_state, chunk, prepared, results)
+                self._score_shared_group(trunk_state, chunk, prepared, results, rows_cap)
         if legacy:
             for start in range(0, len(legacy), self.max_batch_rows):
                 chunk = legacy[start : start + self.max_batch_rows]
@@ -807,16 +840,20 @@ class TPUBackend:
         idxs: List[int],
         prepared,
         results,
+        rows_cap: Optional[int] = None,
     ) -> None:
         from consensus_tpu.models.transformer import shared_context_cont_logprobs
 
         self.call_counts["score"] += len(idxs)
         conts = [prepared[i][2] for i in idxs]
         # Shape discipline: every program here is a fresh remote-AOT compile,
-        # so the variant space must stay SMALL.  Rows always pad to the one
-        # max_batch_rows bucket (padded suffix rows are cheap — the prefill
-        # dominates), and continuation width uses a coarse pow2 ladder.
-        n_rows = self.max_batch_rows
+        # so the variant space must stay SMALL: rows bucket on a coarse pow2
+        # ladder from 32 up to rows_cap (a 5-candidate habermas group must
+        # not pad 4x to a 128-row bucket), continuation width likewise.
+        n_rows = min(
+            rows_cap or max(self.max_batch_rows, 128),
+            _bucket(len(idxs), minimum=32),
+        )
         width = self._shared_cont_width(max(len(c) for c in conts))
         pad = self.tokenizer.pad_id
         cont_tokens = np.full((n_rows, width), pad, np.int32)

@@ -65,9 +65,9 @@ def score_statements_batched(
     """Per-statement welfare metrics with ONE score batch and ONE embed batch
     across (statements × agents) — the TPU-shaped evaluation loop."""
     if embedder is None:
-        from consensus_tpu.embedding import LMPoolEmbedder
+        from consensus_tpu.embedding import get_embedder
 
-        embedder = LMPoolEmbedder(backend)
+        embedder = get_embedder(None, backend)  # honors EVAL_EMBEDDER env
     agents = list(agent_opinions.items())
     requests = [
         ScoreRequest(
@@ -117,9 +117,9 @@ def build_report(
 ) -> Dict[str, Any]:
     data = baseline if baseline is not None else load_baseline()
     if embedder is None:
-        from consensus_tpu.embedding import LMPoolEmbedder
+        from consensus_tpu.embedding import get_embedder
 
-        embedder = LMPoolEmbedder(backend)
+        embedder = get_embedder(None, backend)  # honors EVAL_EMBEDDER env
     # The reference embeds with BAAI/bge-large-en-v1.5 (src/utils.py:376-407);
     # cosine-family numbers are baseline-comparable ONLY under that encoder.
     cosine_comparable = "bge-large-en-v1.5" in embedder.name

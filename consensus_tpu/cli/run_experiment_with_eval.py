@@ -87,9 +87,8 @@ def run_pipeline(
     # or EVAL_EMBEDDER env) — else LM-pooled hiddens (consensus_tpu.embedding).
     from consensus_tpu.embedding import get_embedder
 
-    embedder = get_embedder(
-        (config.get("models") or {}).get("embedding_model_path"), backend
-    )
+    embedding_path = (config.get("models") or {}).get("embedding_model_path")
+    embedder = get_embedder(embedding_path, backend)
 
     # ---- Phase 2a: per-seed comparative ranking -----------------------
     if not skip_comparative_ranking:
@@ -163,10 +162,9 @@ def run_pipeline(
             evaluation_model=model,
             judge_backend=judge_backend_lazy() if include_llm_judge else None,
             llm_judge_model=llm_judge_model,
-            embedder=get_embedder(
-                (config.get("models") or {}).get("embedding_model_path"),
-                model_backend,
-            ),
+            # A path-based embedder is backend-independent — reuse the one
+            # instance instead of re-loading the ST weights per model.
+            embedder=embedder if embedding_path else get_embedder(None, model_backend),
         )
         evaluator.evaluate_results_file(
             str(run_dir / "results.csv"),

@@ -82,9 +82,9 @@ class StatementEvaluator:
         # (reference uses BAAI/bge-large-en-v1.5, src/utils.py:376-407),
         # else the generation LM's pooled hiddens (consensus_tpu.embedding).
         if embedder is None:
-            from consensus_tpu.embedding import LMPoolEmbedder
+            from consensus_tpu.embedding import get_embedder
 
-            embedder = LMPoolEmbedder(backend)
+            embedder = get_embedder(None, backend)  # honors EVAL_EMBEDDER env
         self.embedder = embedder
 
     # ------------------------------------------------------------------

@@ -51,6 +51,9 @@ class ModelConfig:
     # Use the pallas flash-attention kernel on the no-cache (teacher-forced
     # scoring) path instead of materializing (B, H, S, S) logits.
     use_flash_attention: bool = False
+    # Use the pallas fused decode-attention kernel in the session step's
+    # trunk-tail path (ops/decode_attention.py) instead of the einsum pair.
+    use_decode_attention: bool = False
 
     @property
     def q_scale(self) -> float:

@@ -459,30 +459,65 @@ def forward_trunk_tail(
             v_tail, v, (0, write_col, 0, 0)
         )
 
-        qg = q.reshape(n_slots, n_roles, kv, reps, hd)
-        ktg = new_k_tail.reshape(n_slots, n_roles, t_tail, kv, hd)
-        vtg = new_v_tail.reshape(n_slots, n_roles, t_tail, kv, hd)
+        if c.use_decode_attention:
+            # Fused pallas kernel (ops/decode_attention.py): one VMEM pass
+            # per (role, kv-head) instead of four einsums with an fp32
+            # logits intermediate.  Session call sites guarantee per-role
+            # query positions (slots advance in lockstep) — qpos from slot
+            # 0's rows; trunk spans from key_valid (left-padded prefills).
+            from consensus_tpu.ops.decode_attention import decode_attention
 
-        # Trunk attention broadcasts the shared (R, W0) keys over slots.
-        lt = jnp.einsum("prgmd,rtgd->prgmt", qg, k_trunk).astype(jnp.float32)
-        ls = jnp.einsum("prgmd,prtgd->prgmt", qg, ktg).astype(jnp.float32)
-        logits = jnp.concatenate([lt, ls], axis=-1) * c.q_scale
-        logits = _softcap(logits, c.attn_softcap)
-        mask = jnp.concatenate(
-            [
-                jnp.where(is_local, trunk_local, trunk_mask),
-                jnp.where(is_local, tail_local, tail_mask),
-            ],
-            axis=-1,
-        )[:, :, None, None]  # (P, R, 1, 1, W0 + Ts)
-        logits = jnp.where(mask, logits, MASK_FILL)
-        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        w0 = k_trunk.shape[1]
-        attn = jnp.einsum(
-            "prgmt,rtgd->prgmd", weights[..., :w0], v_trunk
-        ) + jnp.einsum(
-            "prgmt,prtgd->prgmd", weights[..., w0:], vtg
-        )
+            interp = jax.default_backend() == "cpu"
+            starts = jnp.argmax(trunk.key_valid, axis=1).astype(jnp.int32)
+            qpos_r = positions.reshape(n_slots, n_roles)[0]
+
+            def call_decode(win):
+                def fn(operands):
+                    qq, tk, tv, lk, lv = operands
+                    return decode_attention(
+                        qq, tk, tv, lk, lv, starts, qpos_r, write_col,
+                        n_slots=n_slots, n_roles=n_roles, scale=c.q_scale,
+                        softcap=c.attn_softcap, window=win,
+                        interpret=interp,
+                    )
+                return fn
+
+            operands = (q[:, 0], k_trunk, v_trunk, new_k_tail, new_v_tail)
+            if c.sliding_window is None:
+                attn = call_decode(None)(operands)
+            else:
+                attn = jax.lax.cond(
+                    is_local,
+                    call_decode(c.sliding_window),
+                    call_decode(None),
+                    operands,
+                )
+            attn = attn.astype(x.dtype)
+        else:
+            qg = q.reshape(n_slots, n_roles, kv, reps, hd)
+            ktg = new_k_tail.reshape(n_slots, n_roles, t_tail, kv, hd)
+            vtg = new_v_tail.reshape(n_slots, n_roles, t_tail, kv, hd)
+
+            # Trunk attention broadcasts the shared (R, W0) keys over slots.
+            lt = jnp.einsum("prgmd,rtgd->prgmt", qg, k_trunk).astype(jnp.float32)
+            ls = jnp.einsum("prgmd,prtgd->prgmt", qg, ktg).astype(jnp.float32)
+            logits = jnp.concatenate([lt, ls], axis=-1) * c.q_scale
+            logits = _softcap(logits, c.attn_softcap)
+            mask = jnp.concatenate(
+                [
+                    jnp.where(is_local, trunk_local, trunk_mask),
+                    jnp.where(is_local, tail_local, tail_mask),
+                ],
+                axis=-1,
+            )[:, :, None, None]  # (P, R, 1, 1, W0 + Ts)
+            logits = jnp.where(mask, logits, MASK_FILL)
+            weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+            w0 = k_trunk.shape[1]
+            attn = jnp.einsum(
+                "prgmt,rtgd->prgmd", weights[..., :w0], v_trunk
+            ) + jnp.einsum(
+                "prgmt,prtgd->prgmd", weights[..., w0:], vtg
+            )
         attn = matmul(attn.reshape(rows, h * hd), lp["wo"])
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)

@@ -1,0 +1,116 @@
+"""A/B: pallas decode-attention kernel vs einsum path on a beam session.
+
+Times advance_and_propose steps of a beam-8 session (the reference's
+widest beam grid, configs/appendix/*/beam_search.yaml) on the real chip,
+einsum vs kernel, interleaved trials, medians (VERDICT r2 #10).
+
+Usage: PYTHONPATH=. python scripts/decode_attention_ab.py [--steps 40]
+       [--beam 8] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import statistics
+import time
+
+from consensus_tpu.backends.session import SearchSpec
+from consensus_tpu.backends.tpu import TPUBackend, TPUTokenSearchSession
+from consensus_tpu.data.aamas_scenarios import SCENARIOS
+from consensus_tpu.methods.prompts import agent_prompt, reference_prompt
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=40)
+    parser.add_argument("--beam", type=int, default=8)
+    parser.add_argument("--trials", type=int, default=3)
+    parser.add_argument("--model", default="gemma2-2b")
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+
+    model = "tiny-gemma2" if args.quick else args.model
+    scenario = SCENARIOS[1]
+    issue, opinions = scenario["issue"], scenario["agent_opinions"]
+    system, user = reference_prompt(issue, opinions, variant="beam_search")
+    agent_prompts = tuple(
+        agent_prompt(issue, opinion, variant="beam_search")
+        for opinion in opinions.values()
+    )
+
+    def run_session(backend, seed):
+        spec = SearchSpec(
+            ref_system=system,
+            ref_user=user,
+            agent_prompts=agent_prompts,
+            n_slots=args.beam,
+            k=args.beam,
+            temperature=1.0,
+            seed=seed,
+            sample=True,
+            max_steps=args.steps + 2,
+        )
+        session = TPUTokenSearchSession(backend, spec)
+        try:
+            props = session.propose()
+            # warm the step program
+            props = session.advance_and_propose(
+                list(range(args.beam)), [slot[0] for slot in props]
+            )
+            start = time.perf_counter()
+            for _ in range(args.steps):
+                props = session.advance_and_propose(
+                    list(range(args.beam)), [slot[0] for slot in props]
+                )
+            elapsed = time.perf_counter() - start
+        finally:
+            session.close()
+        return 1000.0 * elapsed / args.steps  # ms/step
+
+    backends = {}
+    for use_kernel in (False, True):
+        backend = TPUBackend(
+            model=model,
+            max_context=1024 if not args.quick else 256,
+            base_seed=0,
+            quantization=None if args.quick else "int8",
+        )
+        if use_kernel:
+            backend.config = dataclasses.replace(
+                backend.config, use_decode_attention=True
+            )
+        backends[use_kernel] = backend
+
+    print("warmup (compiles both arms)...", flush=True)
+    run_session(backends[False], 900)
+    run_session(backends[True], 900)
+
+    ms = {False: [], True: []}
+    for trial in range(args.trials):
+        for use_kernel in (False, True):
+            step_ms = run_session(backends[use_kernel], 100 + trial)
+            ms[use_kernel].append(step_ms)
+            print(
+                f"trial {trial} kernel={int(use_kernel)}: {step_ms:.1f} ms/step",
+                flush=True,
+            )
+
+    med = statistics.median
+    print(
+        json.dumps(
+            {
+                "model": model,
+                "beam": args.beam,
+                "steps": args.steps,
+                "ms_per_step_einsum": round(med(ms[False]), 2),
+                "ms_per_step_kernel": round(med(ms[True]), 2),
+                "speedup": round(med(ms[False]) / max(med(ms[True]), 1e-9), 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Int8-vs-bf16 end-to-end welfare delta (VERDICT r2 #7).
+
+Weight-only int8 is the production default (it is the only way 8-9B models
+fit one v5e chip), but round 2 shipped it with no measurement of what it
+does to the WELFARE METRICS the paper reports.  This script scores the
+reference's own committed AAMAS statements (the parity harness's fixed
+inputs, so generation randomness is out of the loop) through the SAME
+model weights twice — bf16 and int8-quantized — and reports the per-cell
+egalitarian-perplexity delta.  The weights are random (no checkpoint on
+the box), but quantization noise is a property of the numeric path, not
+of the weight values' provenance; the delta table bounds the metric cost
+of the production default.
+
+Usage: PYTHONPATH=. python scripts/int8_delta_report.py [--model gemma2-2b]
+       [--scenario 1] [--quick]   (repo root; needs the chip unless --quick)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+from datetime import datetime
+
+import numpy as np
+
+from consensus_tpu.backends.tpu import TPUBackend
+from consensus_tpu.cli.parity_report import build_report
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="gemma2-2b")
+    parser.add_argument("--scenario", nargs="*", type=int, default=[1])
+    parser.add_argument("--sweep", nargs="*", default=["habermas_vs_bon"])
+    parser.add_argument("--quick", action="store_true", help="tiny model, CPU-ok")
+    args = parser.parse_args()
+
+    model = "tiny-gemma2" if args.quick else args.model
+    common = dict(
+        model=model,
+        max_context=1024,
+        base_seed=0,
+        use_flash_attention=not args.quick,
+        max_batch_rows=32,
+        shared_context_scoring=True,
+    )
+    reports = {}
+    for mode in ("bf16", "int8"):
+        backend = TPUBackend(
+            quantization=None if mode == "bf16" else "int8", **common
+        )
+        reports[mode] = build_report(
+            backend,
+            scenarios=args.scenario,
+            sweeps=args.sweep,
+            weights="random (identical across modes: same base_seed)",
+        )
+        del backend
+
+    rows = []
+    for bf16_cell, int8_cell in zip(
+        reports["bf16"]["cells"], reports["int8"]["cells"]
+    ):
+        assert bf16_cell["method"] == int8_cell["method"]
+        assert bf16_cell["params"] == int8_cell["params"]
+        bf16_ppl = bf16_cell["local_egalitarian_perplexity"]
+        int8_ppl = int8_cell["local_egalitarian_perplexity"]
+        rows.append(
+            {
+                "scenario": bf16_cell["scenario"],
+                "method": bf16_cell["method"],
+                "params": bf16_cell["params"],
+                "egal_ppl_bf16": bf16_ppl,
+                "egal_ppl_int8": int8_ppl,
+                "delta_pct": round(100.0 * (int8_ppl - bf16_ppl) / bf16_ppl, 3),
+            }
+        )
+
+    deltas = [abs(r["delta_pct"]) for r in rows]
+    payload = {
+        "generated": datetime.now().isoformat(timespec="seconds"),
+        "model": model,
+        "weights": "random (same base_seed both modes; fixed reference statements)",
+        "n_cells": len(rows),
+        "mean_abs_delta_pct": round(float(np.mean(deltas)), 3) if deltas else None,
+        "max_abs_delta_pct": round(float(np.max(deltas)), 3) if deltas else None,
+        "cells": rows,
+    }
+    out = pathlib.Path("reports")
+    out.mkdir(exist_ok=True)
+    (out / "int8_delta.json").write_text(json.dumps(payload, indent=2))
+
+    lines = [
+        "# Int8-vs-bf16 welfare delta (production quantization default)",
+        "",
+        f"- Generated: {payload['generated']}  |  model: {model}",
+        "- Inputs: the reference's committed AAMAS statements (fixed), scored",
+        "  by the SAME random weights in bf16 and int8 — the delta isolates",
+        "  the quantization noise of the metric path.",
+        f"- Cells: {payload['n_cells']}  |  mean |Δ egal-ppl|: "
+        f"{payload['mean_abs_delta_pct']}%  |  max: {payload['max_abs_delta_pct']}%",
+        "",
+        "| scenario | method | params | egal ppl bf16 | egal ppl int8 | Δ% |",
+        "|---|---|---|---|---|---|",
+    ]
+    for row in rows:
+        params = ", ".join(f"{k}={v}" for k, v in row["params"].items())
+        lines.append(
+            f"| {row['scenario']} | {row['method']} | {params} "
+            f"| {row['egal_ppl_bf16']} | {row['egal_ppl_int8']} "
+            f"| {row['delta_pct']} |"
+        )
+    (out / "int8_delta.md").write_text("\n".join(lines) + "\n")
+    print(
+        json.dumps(
+            {k: payload[k] for k in ("n_cells", "mean_abs_delta_pct", "max_abs_delta_pct")}
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""Pin the pallas decode-attention kernel against the einsum path.
+
+The reference semantics are ``transformer.forward_trunk_tail``'s attention
+block (trunk broadcast over slots + per-row tails); the kernel must
+reproduce it for the session call sites' layout (shared query position,
+left-padded trunk spans, tail columns <= write_col), with and without
+Gemma-2's softcap/sliding-window.  Runs in interpret mode on CPU; the same
+kernel compiles via Mosaic on TPU.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consensus_tpu.ops.decode_attention import decode_attention
+
+
+def einsum_reference(
+    q, trunk_k, trunk_v, tail_k, tail_v, starts, qpos, write_col,
+    n_slots, n_roles, scale, softcap=None, window=None,
+):
+    """The forward_trunk_tail attention block, re-expressed directly."""
+    rows, h, hd = q.shape
+    kv = trunk_k.shape[2]
+    reps = h // kv
+    w0 = trunk_k.shape[1]
+    ts = tail_k.shape[1]
+
+    qg = q.reshape(n_slots, n_roles, kv, reps, hd).astype(jnp.float32)
+    ktr = trunk_k.transpose(0, 2, 1, 3).astype(jnp.float32)  # (R, KV, W0, hd)
+    vtr = trunk_v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    ktl = tail_k.reshape(n_slots, n_roles, ts, kv, hd).astype(jnp.float32)
+    vtl = tail_v.reshape(n_slots, n_roles, ts, kv, hd).astype(jnp.float32)
+
+    lt = jnp.einsum("prgmd,rgtd->prgmt", qg, ktr)
+    ls = jnp.einsum("prgmd,prtgd->prgmt", qg, ktl)
+    logits = jnp.concatenate([lt, ls], axis=-1) * scale
+    if softcap is not None:
+        logits = softcap * jnp.tanh(logits / softcap)
+
+    kiota = jnp.arange(w0)[None, :]
+    trunk_ok = kiota >= starts[:, None]  # (R, W0)
+    if window is not None:
+        trunk_ok = trunk_ok & (qpos - (kiota - starts[:, None]) < window)
+    cols = jnp.arange(ts)
+    tail_ok = cols <= write_col
+    if window is not None:
+        tail_ok = tail_ok & (write_col - cols < window)
+    mask = jnp.concatenate(
+        [
+            jnp.broadcast_to(trunk_ok[None], (n_slots, n_roles, w0)),
+            jnp.broadcast_to(tail_ok[None, None], (n_slots, n_roles, ts)),
+        ],
+        axis=-1,
+    )[:, :, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1)
+    attn = jnp.einsum("prgmt,rgtd->prgmd", weights[..., :w0], vtr) + jnp.einsum(
+        "prgmt,prtgd->prgmd", weights[..., w0:], vtl
+    )
+    return attn.reshape(rows, h, hd)
+
+
+def random_case(seed, n_slots=3, n_roles=2, kv=2, reps=2, hd=128, w0=96, ts=16):
+    rng = np.random.default_rng(seed)
+    h = kv * reps
+    rows = n_slots * n_roles
+    q = rng.standard_normal((rows, h, hd), dtype=np.float32)
+    trunk_k = rng.standard_normal((n_roles, w0, kv, hd), dtype=np.float32)
+    trunk_v = rng.standard_normal((n_roles, w0, kv, hd), dtype=np.float32)
+    tail_k = rng.standard_normal((rows, ts, kv, hd), dtype=np.float32)
+    tail_v = rng.standard_normal((rows, ts, kv, hd), dtype=np.float32)
+    starts = np.array([5, 17][:n_roles] + [3] * max(0, n_roles - 2), np.int32)[
+        :n_roles
+    ]
+    return q, trunk_k, trunk_v, tail_k, tail_v, starts
+
+
+@pytest.mark.parametrize(
+    "softcap,window",
+    [(None, None), (50.0, None), (50.0, 48), (None, 24)],
+)
+def test_kernel_matches_einsum(softcap, window):
+    n_slots, n_roles = 3, 2
+    q, tk, tv, lk, lv, starts = random_case(0, n_slots=n_slots, n_roles=n_roles)
+    qpos, write_col = 101, 7
+    args = dict(
+        n_slots=n_slots, n_roles=n_roles, scale=0.088, softcap=softcap,
+        window=window,
+    )
+    ours = decode_attention(
+        jnp.asarray(q), jnp.asarray(tk), jnp.asarray(tv),
+        jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(starts),
+        jnp.asarray(qpos), jnp.asarray(write_col),
+        block_k=64, interpret=True, **args,
+    )
+    ref = einsum_reference(
+        jnp.asarray(q), jnp.asarray(tk), jnp.asarray(tv),
+        jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(starts),
+        qpos, write_col, **args,
+    )
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_first_step_write_col_zero():
+    """write_col=0: only the current token's own tail column is visible."""
+    n_slots, n_roles = 2, 3
+    q, tk, tv, lk, lv, starts = random_case(
+        1, n_slots=n_slots, n_roles=n_roles, w0=64, ts=8
+    )
+    starts = np.array([0, 9, 30], np.int32)
+    ours = decode_attention(
+        jnp.asarray(q), jnp.asarray(tk), jnp.asarray(tv),
+        jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(starts),
+        jnp.asarray(63), jnp.asarray(0),
+        n_slots=n_slots, n_roles=n_roles, scale=0.1,
+        block_k=32, interpret=True,
+    )
+    ref = einsum_reference(
+        jnp.asarray(q), jnp.asarray(tk), jnp.asarray(tv),
+        jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(starts),
+        63, 0, n_slots=n_slots, n_roles=n_roles, scale=0.1,
+    )
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_single_slot_trunk_session():
+    """MCTS/lookahead trunk sessions: n_slots=1."""
+    q, tk, tv, lk, lv, starts = random_case(
+        2, n_slots=1, n_roles=3, w0=128, ts=32
+    )
+    starts = np.array([2, 0, 64], np.int32)
+    ours = decode_attention(
+        jnp.asarray(q), jnp.asarray(tk), jnp.asarray(tv),
+        jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(starts),
+        jnp.asarray(140), jnp.asarray(12),
+        n_slots=1, n_roles=3, scale=0.0884, softcap=30.0, window=96,
+        block_k=64, interpret=True,
+    )
+    ref = einsum_reference(
+        jnp.asarray(q), jnp.asarray(tk), jnp.asarray(tv),
+        jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(starts),
+        140, 12, n_slots=1, n_roles=3, scale=0.0884, softcap=30.0, window=96,
+    )
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_session_with_kernel_matches_einsum_path():
+    """End-to-end: a beam session on the kernel-enabled config proposes the
+    same tokens as the einsum path (tiny model, CPU interpret mode)."""
+    from consensus_tpu.backends.session import SearchSpec
+    from consensus_tpu.backends.tpu import TPUBackend, TPUTokenSearchSession
+
+    spec = SearchSpec(
+        ref_system="You draft consensus statements.",
+        ref_user="Issue: trees.\nStatement:",
+        agent_prompts=(
+            ("Agent context.", "Opinion: plant more.\nStatement:"),
+            ("Agent context.", "Opinion: too costly.\nStatement:"),
+        ),
+        n_slots=2,
+        k=3,
+        temperature=1.0,
+        seed=11,
+        sample=False,
+        max_steps=4,
+    )
+    results = {}
+    for use_kernel in (False, True):
+        backend = TPUBackend(
+            model="tiny-gemma2",
+            dtype="float32",
+            max_context=128,
+            base_seed=0,
+            use_flash_attention=False,
+        )
+        if use_kernel:
+            import dataclasses
+
+            backend.config = dataclasses.replace(
+                backend.config, use_decode_attention=True
+            )
+        session = TPUTokenSearchSession(backend, spec)
+        try:
+            props = session.propose()
+            step = session.advance_and_propose(
+                [0, 1], [props[0][0], props[1][1]]
+            )
+            results[use_kernel] = [
+                [(c.token_id, round(sum(c.agent_logprobs), 4)) for c in slot]
+                for slot in step
+            ]
+        finally:
+            session.close()
+    assert results[True] == results[False]
