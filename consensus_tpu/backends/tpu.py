@@ -143,6 +143,7 @@ class TPUBackend:
         params: Optional[Dict[str, Any]] = None,
         config: Optional[ModelConfig] = None,
         use_flash_attention: bool = False,
+        use_decode_attention: bool = False,
         max_batch_rows: int = 64,
         quantization: Optional[str] = None,
         shared_context_scoring: bool = False,
@@ -154,6 +155,10 @@ class TPUBackend:
             import dataclasses
 
             self.config = dataclasses.replace(self.config, use_flash_attention=True)
+        if use_decode_attention and not self.config.use_decode_attention:
+            import dataclasses
+
+            self.config = dataclasses.replace(self.config, use_decode_attention=True)
         self.model_name = model
         family = "llama" if "llama" in self.config.name else "gemma"
         self.tokenizer = get_tokenizer(tokenizer, family=family)

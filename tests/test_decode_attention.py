@@ -174,13 +174,8 @@ def test_session_with_kernel_matches_einsum_path():
             max_context=128,
             base_seed=0,
             use_flash_attention=False,
+            use_decode_attention=use_kernel,
         )
-        if use_kernel:
-            import dataclasses
-
-            backend.config = dataclasses.replace(
-                backend.config, use_decode_attention=True
-            )
         session = TPUTokenSearchSession(backend, spec)
         try:
             props = session.propose()
