@@ -42,9 +42,12 @@ def run_pipeline(
     skip_llm_judge: bool = False,
     llm_judge_model: str = "",
     evaluation_models: Optional[List[str]] = None,
+    config_overrides: Optional[dict] = None,
 ) -> str:
     with open(config_path) as fh:
         config = yaml.safe_load(fh)
+    if config_overrides:
+        config.update(config_overrides)
 
     # ---- Phase 1: generation ------------------------------------------
     logger.info("=== Phase 1: generation ===")

@@ -63,6 +63,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--scenario", nargs="*", type=int, default=None)
     parser.add_argument("--method", nargs="*", default=None)
     parser.add_argument("--skip-comparative-ranking", action="store_true")
+    parser.add_argument(
+        "--timing-pin-budget", action="store_true",
+        help="timing mode: pin every generation to its full token budget "
+        "(no EOS/terminator early exit) so random-weight timings measure "
+        "the full-budget workload; never use for quality runs",
+    )
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args(argv)
 
@@ -83,6 +89,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             run_dir = run_pipeline(
                 str(config),
                 skip_comparative_ranking=args.skip_comparative_ranking,
+                config_overrides=(
+                    {"timing_pin_budget": True} if args.timing_pin_budget else None
+                ),
             )
             logger.info(
                 "[%d/%d] done in %.1fs -> %s",

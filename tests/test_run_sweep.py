@@ -80,3 +80,29 @@ def test_sweep_runs_every_matching_config(tmp_path, monkeypatch):
         assert len(df) == 1 and df["error_message"].isna().all()
         agg = csv.parent / "evaluation" / "improved_aggregate" / "aggregated_metrics.csv"
         assert agg.exists()
+
+
+def test_sweep_timing_pin_budget_reaches_runs(tmp_path, monkeypatch):
+    """--timing-pin-budget injects timing_pin_budget into every config: the
+    run dir's token_counts.json records pinned_budget=true and the method
+    run configs carry pin_budget."""
+    import json
+
+    write_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    rc = main(
+        [
+            "--configs-root", str(tmp_path),
+            "--model", "llama",
+            "--scenario", "1",
+            "--method", "quick_bon",
+            "--skip-comparative-ranking",
+            "--timing-pin-budget",
+            "--quiet",
+        ]
+    )
+    assert rc == 0
+    tokens = sorted((tmp_path / "out").glob("*/token_counts.json"))
+    assert tokens, "run dir missing token_counts.json"
+    payload = json.loads(tokens[-1].read_text())
+    assert payload["pinned_budget"] is True
