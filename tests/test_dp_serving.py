@@ -126,3 +126,32 @@ def test_dp_welfare_pipeline_matches_single_device(single, dp8):
     assert gen_dp.generate_statement(issue, opinions) == gen_single.generate_statement(
         issue, opinions
     )
+
+
+def test_dp_composes_with_int8(single):
+    """Pure-DP serving with the production int8 weights: dp=8 results equal
+    the single-device bf16-path backend only in structure (different
+    quantization), so compare against a single-device int8 backend."""
+    int8_single = TPUBackend(
+        model="tiny-gemma2", max_context=128, base_seed=7, quantization="int8"
+    )
+    int8_dp = TPUBackend(
+        model="tiny-gemma2", max_context=128, base_seed=7, quantization="int8",
+        dp=8,
+    )
+    requests = [
+        GenerationRequest(user_prompt=p, max_tokens=6, seed=200 + i)
+        for i, p in enumerate(PROMPTS[:8])
+    ]
+    ours = int8_dp.generate(requests)
+    ref = int8_single.generate(requests)
+    assert [r.token_ids for r in ours] == [r.token_ids for r in ref]
+
+    scores_dp = int8_dp.score(
+        [ScoreRequest(context="ctx", continuation=p) for p in PROMPTS[:8]]
+    )
+    scores_ref = int8_single.score(
+        [ScoreRequest(context="ctx", continuation=p) for p in PROMPTS[:8]]
+    )
+    for a, b in zip(scores_dp, scores_ref):
+        np.testing.assert_allclose(a.logprobs, b.logprobs, atol=1e-5, rtol=1e-5)
