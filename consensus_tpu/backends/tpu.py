@@ -566,7 +566,13 @@ class TPUBackend:
         from consensus_tpu.models.generate import generate_tokens_shared_trunk
 
         max_new = _width_bucket(max(r.max_tokens for r in requests), minimum=16)
-        width = min(_width_bucket(len(prompt_ids)), self.max_context)
+        # ONE trunk-width variant: the trunk is a single row, so padding its
+        # prefill to max_context costs ~nothing — while letting its width
+        # float over the {1,1.5}-pow2 ladder multiplies the remote-AOT
+        # program space by every ladder step a scenario's prompts touch
+        # (measured: scenario-3's new buckets alone cost ~50 min of serial
+        # decode-loop compiles in the round-3 sweep).
+        width = self.max_context
         prompt_ids = prompt_ids[-width:]
         # Tail-only per-row HBM (the trunk is one row, a closure constant):
         # rows are ~(ctx+2·max_new)/(2·max_new) times cheaper than classic.
@@ -780,7 +786,7 @@ class TPUBackend:
             # group rides 2 dispatches instead of 8) and halve until the
             # transient fits.
             cont_width = self._shared_cont_width(max_cont)
-            ctx_width = min(_width_bucket(len(ctx_ids)), self.max_context)
+            ctx_width = self.max_context  # matches _shared_prefill's padding
             rows_cap = max(self.max_batch_rows, 128)
             while rows_cap >= 8:
                 attn_bytes = (
@@ -826,10 +832,15 @@ class TPUBackend:
         return results  # type: ignore[return-value]
 
     def _shared_prefill(self, ctx_ids: List[int]):
-        """Prefill one shared scoring context into a resident trunk."""
+        """Prefill one shared scoring context into a resident trunk.
+
+        ONE width variant: the context is a single row, so padding to
+        max_context is ~free, and the trunk's width is baked into every
+        downstream suffix-scorer program shape — a floating width would
+        multiply the remote-AOT compile space per scenario."""
         from consensus_tpu.models.transformer import shared_context_prefill
 
-        ctx_width = min(_width_bucket(len(ctx_ids)), self.max_context)
+        ctx_width = self.max_context
         pad = self.tokenizer.pad_id
         ctx_tokens = np.full((1, ctx_width), pad, np.int32)
         ctx_tokens[0, : len(ctx_ids)] = ctx_ids
