@@ -133,6 +133,13 @@ class HabermasMachineGenerator(BaseGenerator):
         self._num_retries = int(cfg.get("num_retries_on_error", 1))
         self._tie_breaking = cfg.get("tie_breaking_method", "random")
         self._max_tokens = int(cfg.get("max_tokens", 700))
+        # Timing mode (experiment timing_pin_budget): random weights cannot
+        # emit the CoT <answer> envelope, so without a fallback the whole
+        # deliberation pipeline short-circuits after the candidate phase and
+        # the cell times only 1 of its 4+ phases.  Here parse failures fall
+        # back (raw text as candidate/critique, identity ranking) so every
+        # phase runs its real workload.  Never affects quality runs.
+        self._timing_fallbacks = bool(cfg.get("pin_budget"))
 
         opinions = list(agent_opinions.values())
 
@@ -237,6 +244,8 @@ class HabermasMachineGenerator(BaseGenerator):
             responses = self._generate_batch([prompt] * missing, seeds, 1.0)
             for response in responses:
                 parsed = extract_statement(response)
+                if parsed is None and self._timing_fallbacks and response.strip():
+                    parsed = response.strip()[:300]
                 if parsed:
                     statements.append(parsed)
         return statements[:n]
@@ -273,6 +282,9 @@ class HabermasMachineGenerator(BaseGenerator):
                 else:
                     still.append(i)
             pending = still
+        if pending and self._timing_fallbacks:
+            for i in pending:
+                rankings[agents[i][0]] = np.arange(len(statements))
         return rankings
 
     def _winner(
@@ -307,7 +319,13 @@ class HabermasMachineGenerator(BaseGenerator):
             for i in range(len(prompts))
         ]
         responses = self._generate_batch(prompts, seeds, 1.0)
-        return [extract_statement(r) for r in responses]
+        critiques = [extract_statement(r) for r in responses]
+        if self._timing_fallbacks:
+            critiques = [
+                c if c is not None else (r.strip()[:300] or None)
+                for c, r in zip(critiques, responses)
+            ]
+        return critiques
 
     def _revisions(
         self,
@@ -331,7 +349,13 @@ class HabermasMachineGenerator(BaseGenerator):
                 for i in range(missing)
             ]
             responses = self._generate_batch([prompt] * missing, seeds, 1.0)
-            revised.extend(p for p in map(extract_statement, responses) if p)
+            parsed = list(map(extract_statement, responses))
+            if self._timing_fallbacks:
+                parsed = [
+                    p if p is not None else (r.strip()[:300] or None)
+                    for p, r in zip(parsed, responses)
+                ]
+            revised.extend(p for p in parsed if p)
         while len(revised) < n:
             revised.append(winner)
         return revised[:n]

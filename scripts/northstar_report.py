@@ -177,8 +177,14 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
             "`<answer>` envelope from byte noise (the reference skips such "
             "candidates identically, habermas_machine.py:480-527), and the "
             "fixed random model rates `\\n` above average so lookahead's "
-            "1-token terminator path keeps winning.  All generation/scoring "
-            "compute still runs, so the timings measure the real workload.",
+            "1-token terminator path keeps winning.  TIMING CAVEAT: when "
+            "every candidate fails to parse, the habermas pipeline "
+            "short-circuits after the candidate phase (+1 retry), so "
+            "unpinned habermas cells time ~1 of its 4+ phases; the "
+            "pinned-budget pass (`--timing-pin-budget`) adds parse "
+            "fallbacks so every phase runs — use ITS habermas numbers as "
+            "the full-workload cost.  Beam/lookahead/bon cells run their "
+            "full compute either way.",
             "",
         ]
     lines += [

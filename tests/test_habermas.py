@@ -106,3 +106,31 @@ def test_ranking_failure_falls_back_to_first_candidate(monkeypatch, backend):
     )
     statement = gen.generate_statement(ISSUE, OPINIONS)
     assert statement == gen.candidate_statements[0]
+
+
+def test_timing_fallbacks_run_full_pipeline():
+    """pin_budget timing mode: unparseable responses fall back (raw text as
+    candidate/critique, identity ranking) so every deliberation phase runs —
+    without it a random-weight model short-circuits after the candidate
+    phase and a timed cell measures 1 of 4+ phases."""
+    from consensus_tpu.backends.tpu import TPUBackend
+    from consensus_tpu.methods import get_method_generator
+
+    backend = TPUBackend(model="tiny-gemma2", max_context=256, base_seed=1)
+    generator = get_method_generator(
+        "habermas_machine",
+        backend,
+        {"num_candidates": 2, "num_rounds": 1, "max_tokens": 16,
+         "seed": 3, "pin_budget": True},
+        "tiny-gemma2",
+    )
+    statement = generator.generate_statement(
+        "Trees?", {"Agent 1": "yes", "Agent 2": "no"}
+    )
+    # Full pipeline ran: candidates exist, every agent ranked (fallback
+    # identity at worst), and at least one critique/revision round recorded.
+    assert statement and not statement.startswith("[ERROR")
+    assert generator.candidate_statements
+    assert all(r is not None for r in generator.agent_rankings.values())
+    assert generator.all_round_data
+    assert generator.all_round_data[0].get("revised_statements")
