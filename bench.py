@@ -90,7 +90,9 @@ def main() -> None:
         """Run N_CONCURRENT statements through one BatchingBackend (the
         sweep regime, experiment.py's concurrent path); returns wall s."""
         batching = BatchingBackend(
-            backend, flush_ms=10.0, expected_sessions=N_CONCURRENT
+            backend,
+            flush_ms=float(os.environ.get("BENCH_FLUSH_MS", "10")),
+            expected_sessions=N_CONCURRENT,
         )
 
         def worker(i: int) -> str:
