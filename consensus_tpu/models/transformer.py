@@ -398,6 +398,9 @@ def forward_trunk_tail(
     write_col: jax.Array,  # () int32 — tail column for this step's token
     n_slots: int,
     n_roles: int,
+    frozen_k: Optional[jax.Array] = None,  # (L, Rows, F, KV, hd) read-only
+    frozen_v: Optional[jax.Array] = None,
+    frozen_positions: Optional[jax.Array] = None,  # (Rows, F) int32
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One-token decode step where every search slot shares ONE trunk cache.
 
@@ -411,6 +414,15 @@ def forward_trunk_tail(
     state.  Tail columns <= ``write_col`` are visible (the current token
     writes there first).
 
+    ``frozen_*``: an optional second read-only KV source holding tokens the
+    row generated in EARLIER decode segments (models/generate.py's
+    segmented decode).  The live tail rides the while_loop carry, which the
+    remote AOT compiler double-buffers — copying the full (Rows, Ts) tail
+    every step dominates long decodes (measured 44 ms/step at 64x768 vs a
+    ~6 ms roofline, scripts/decode_step_bench.py).  Frozen columns are a
+    plain operand: read once per step by attention, never copied, and
+    always fully visible (segments append whole seg_len blocks).
+
     Returns (final-norm hidden (Rows, D), new tail_k, new tail_v).
     """
     c = config
@@ -418,6 +430,8 @@ def forward_trunk_tail(
     reps = h // kv
     rows = tokens.shape[0]
     t_tail = tail_k.shape[2]
+    has_frozen = frozen_k is not None
+    t_frozen = frozen_k.shape[2] if has_frozen else 0
 
     x = take_rows(params["embed"], tokens)  # (Rows, D)
     if c.scale_embeddings:
@@ -440,10 +454,23 @@ def forward_trunk_tail(
         trunk_local = trunk_mask
         tail_local = jnp.broadcast_to(tail_fill, (n_slots, n_roles, t_tail))
     tail_mask = jnp.broadcast_to(tail_fill, (n_slots, n_roles, t_tail))
+    if has_frozen:
+        # Frozen columns are always fully valid — segments append exactly
+        # seg_len columns each (generate.py) — so only the sliding window
+        # ever masks them.
+        frozen_mask = jnp.ones((n_slots, n_roles, t_frozen), bool)
+        if c.sliding_window is not None:
+            frozen_kp = frozen_positions.reshape(n_slots, n_roles, t_frozen)
+            frozen_local = qp[:, :, None] - frozen_kp < c.sliding_window
+        else:
+            frozen_local = frozen_mask
     local_flags = jnp.asarray(c.local_flags)
 
     def layer_step(x, scanned):
-        lp, k_trunk, v_trunk, k_tail, v_tail, is_local = scanned
+        if has_frozen:
+            lp, k_trunk, v_trunk, k_froz, v_froz, k_tail, v_tail, is_local = scanned
+        else:
+            lp, k_trunk, v_trunk, k_tail, v_tail, is_local = scanned
 
         attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
         q = matmul(attn_in, lp["wq"]).reshape(rows, 1, h, hd)
@@ -459,7 +486,7 @@ def forward_trunk_tail(
             v_tail, v, (0, write_col, 0, 0)
         )
 
-        if c.use_decode_attention:
+        if c.use_decode_attention and not has_frozen:
             # Fused pallas kernel (ops/decode_attention.py): one VMEM pass
             # per (role, kv-head) instead of four einsums with an fp32
             # logits intermediate.  Session call sites guarantee per-role
@@ -501,23 +528,34 @@ def forward_trunk_tail(
             # Trunk attention broadcasts the shared (R, W0) keys over slots.
             lt = jnp.einsum("prgmd,rtgd->prgmt", qg, k_trunk).astype(jnp.float32)
             ls = jnp.einsum("prgmd,prtgd->prgmt", qg, ktg).astype(jnp.float32)
-            logits = jnp.concatenate([lt, ls], axis=-1) * c.q_scale
+            blocks = [lt, ls]
+            masks = [
+                jnp.where(is_local, trunk_local, trunk_mask),
+                jnp.where(is_local, tail_local, tail_mask),
+            ]
+            if has_frozen:
+                kfg = k_froz.reshape(n_slots, n_roles, t_frozen, kv, hd)
+                lf = jnp.einsum("prgmd,prtgd->prgmt", qg, kfg).astype(jnp.float32)
+                # Chronological key order [trunk, frozen, tail].
+                blocks.insert(1, lf)
+                masks.insert(1, jnp.where(is_local, frozen_local, frozen_mask))
+            logits = jnp.concatenate(blocks, axis=-1) * c.q_scale
             logits = _softcap(logits, c.attn_softcap)
-            mask = jnp.concatenate(
-                [
-                    jnp.where(is_local, trunk_local, trunk_mask),
-                    jnp.where(is_local, tail_local, tail_mask),
-                ],
-                axis=-1,
-            )[:, :, None, None]  # (P, R, 1, 1, W0 + Ts)
+            mask = jnp.concatenate(masks, axis=-1)[:, :, None, None]
             logits = jnp.where(mask, logits, MASK_FILL)
             weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
             w0 = k_trunk.shape[1]
             attn = jnp.einsum(
                 "prgmt,rtgd->prgmd", weights[..., :w0], v_trunk
             ) + jnp.einsum(
-                "prgmt,prtgd->prgmd", weights[..., w0:], vtg
+                "prgmt,prtgd->prgmd", weights[..., w0 + t_frozen:], vtg
             )
+            if has_frozen:
+                vfg = v_froz.reshape(n_slots, n_roles, t_frozen, kv, hd)
+                attn = attn + jnp.einsum(
+                    "prgmt,prtgd->prgmd",
+                    weights[..., w0 : w0 + t_frozen], vfg,
+                )
         attn = matmul(attn.reshape(rows, h * hd), lp["wo"])
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
@@ -534,11 +572,14 @@ def forward_trunk_tail(
             ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
         return x + ffn, (new_k_tail, new_v_tail)
 
-    x, (new_tail_k, new_tail_v) = jax.lax.scan(
-        layer_step,
-        x,
-        (params["layers"], trunk.k, trunk.v, tail_k, tail_v, local_flags),
-    )
+    if has_frozen:
+        scanned = (
+            params["layers"], trunk.k, trunk.v, frozen_k, frozen_v,
+            tail_k, tail_v, local_flags,
+        )
+    else:
+        scanned = (params["layers"], trunk.k, trunk.v, tail_k, tail_v, local_flags)
+    x, (new_tail_k, new_tail_v) = jax.lax.scan(layer_step, x, scanned)
     x = rms_norm(x, params["final_norm"], c.rms_eps, c.rmsnorm_style)
     return x, new_tail_k, new_tail_v
 

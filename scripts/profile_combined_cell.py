@@ -1,0 +1,71 @@
+"""Profile one north-star habermas_vs_best_of_n cell: FULL pipeline.
+
+Companion to profile_habermas_cell.py, for the sweep's dominant cells
+(~700-810 s each, 5 of 20 configs but ~2/3 of the 92-min wall).  Runs the
+complete run_pipeline (generation + evaluation + aggregation) with the
+backend's generate/score instrumented, and prints a phase/dispatch
+breakdown so the ~500 s the cell spends beyond habermas generation is
+attributed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from consensus_tpu.backends import get_backend
+from consensus_tpu.cli.run_experiment_with_eval import run_pipeline
+
+CONFIG = os.environ.get(
+    "PROFILE_CONFIG", "configs/north_star/gemma/scenario_1/habermas_vs_best_of_n.yaml"
+)
+
+import yaml  # noqa: E402
+
+
+def main() -> None:
+    with open(CONFIG) as f:
+        config = yaml.safe_load(f)
+    backend = get_backend(config.get("backend"), **(config.get("backend_options") or {}))
+
+    calls = {"generate": [], "score": [], "embed": []}
+    for kind in list(calls):
+        orig = getattr(backend, kind)
+
+        def timed(requests, _orig=orig, _kind=kind):
+            t0 = time.perf_counter()
+            out = _orig(requests)
+            calls[_kind].append(
+                {"rows": len(requests), "wall_s": round(time.perf_counter() - t0, 3)}
+            )
+            return out
+
+        setattr(backend, kind, timed)
+
+    t0 = time.perf_counter()
+    run_dir = run_pipeline(
+        CONFIG,
+        skip_comparative_ranking=True,
+        skip_llm_judge=True,
+        config_overrides={"output_dir": "/tmp/profile_combined"},
+    )
+    total = time.perf_counter() - t0
+
+    summary = {"cell_wall_s": round(total, 1), "run_dir": str(run_dir)}
+    for kind, entries in calls.items():
+        summary[kind] = {
+            "calls": len(entries),
+            "rows": sum(e["rows"] for e in entries),
+            "wall_s": round(sum(e["wall_s"] for e in entries), 1),
+        }
+    summary["token_counts"] = dict(getattr(backend, "token_counts", {}) or {})
+    print(json.dumps(summary, indent=2))
+    for kind, entries in calls.items():
+        print(f"\n-- {kind} calls --")
+        for e in entries:
+            print(f"  rows={e['rows']:4d}  wall={e['wall_s']:9.3f}s")
+
+
+if __name__ == "__main__":
+    main()
