@@ -674,6 +674,7 @@ class TPUBackend:
             )
 
             kwargs["seg_len"] = seg_len
+            kwargs["dp_align"] = self._dp  # compaction keeps dp-divisible rows
         else:
             fn = generate_tokens_shared_trunk
         out = fn(
@@ -745,6 +746,7 @@ class TPUBackend:
             )
 
             kwargs["seg_len"] = seg_len
+            kwargs["dp_align"] = self._dp  # compaction keeps dp-divisible rows
         else:
             fn = generate_tokens
         out = fn(self.params, self.config, tokens, valid, keys, **kwargs)

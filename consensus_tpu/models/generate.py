@@ -53,6 +53,24 @@ def left_pad_positions(valid: jax.Array) -> jax.Array:
     return jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
 
 
+def _take_rows_keep_sharding(array, idx, axis):
+    """Row gather that PRESERVES the input's named sharding.
+
+    ``jnp.take`` with an index vector returns a fully REPLICATED result on
+    a mesh (verified on an 8-device CPU mesh) — a compaction gather would
+    silently de-shard the frozen KV and trunk for every later segment,
+    losing the dp split and exceeding the per-device HBM the row allowance
+    models.  Re-placing with the source's NamedSharding keeps batch rows on
+    the ``data`` axis (the halved batch stays dp-divisible by the
+    ``dp_align`` guard).
+    """
+    out = jnp.take(array, idx, axis=axis)
+    sharding = getattr(array, "sharding", None)
+    if sharding is not None and getattr(sharding, "spec", None) is not None:
+        out = jax.device_put(out, sharding)
+    return out
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("config", "max_new_tokens", "top_k", "top_p", "pad_id"),
@@ -315,19 +333,40 @@ def _segmented_loop(
     bias_index,
     pad_id: int,
     logit_bias=None,
+    dp_align: int = 1,
 ) -> GenerateOutput:
     """Host loop over ``_decode_segment`` calls shared by both layouts.
 
     Between segments the host checks whether every row is done — real
     statements finish at a fraction of the 700-token habermas budget, so
     whole segments are skipped where a monolithic loop only skips steps.
+
+    Rows that finish COMPACT away at segment boundaries — but only by
+    HALVING the batch: every per-row array (and, in the classic layout,
+    the per-row trunk) gathers down to the survivors, so later segments
+    pay weights+KV traffic only for rows still decoding.  Halving-only
+    keeps the compiled-program space bounded (log2 row variants per
+    frozen-width family, vs one per ladder bucket) and each halving
+    guarantees >=2x per-step tail savings.  ``dp_align`` preserves the
+    backend's dp-divisibility invariant: a halved batch that no longer
+    divides the data mesh axis would silently lose the dp sharding.
+    Per-row PRNG keys make each row's stream independent of batch
+    composition (the invariant tests/test_batching.py already pins), so
+    compaction changes no tokens — only traffic.
     """
     import numpy as np
 
     batch = n_slots * n_roles
+    shared_layout = n_roles == 1
+    orig_batch = batch
+    row_map = np.arange(batch)  # current row -> original row
+    # Scalar-key streams are batch-coupled (one draw feeds all rows), so
+    # row gathers would change them; compact only with per-row keys.
+    can_compact = getattr(keys, "ndim", 0) == 2 and jnp.ndim(temperature) == 1
+
     frozen_k = frozen_v = None
-    token_rows = []
-    emitted_rows = []
+    tokens = np.full((orig_batch, max_new_tokens), pad_id, np.int32)
+    emitted = np.zeros((orig_batch, max_new_tokens), bool)
     n_segs = max_new_tokens // seg_len
     for seg in range(n_segs):
         tokens_buf, emitted_buf, next_logits, tail_k, tail_v, done, keys = (
@@ -335,32 +374,68 @@ def _segmented_loop(
                 params, config, trunk, frozen_k, frozen_v,
                 base_pos, jnp.asarray(seg * seg_len, jnp.int32),
                 next_logits, keys, done,
-                n_slots=n_slots, n_roles=n_roles, seg_len=seg_len,
+                n_slots=batch if shared_layout else 1,
+                n_roles=1 if shared_layout else batch,
+                seg_len=seg_len,
                 temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_ids=eos_ids,
                 logit_bias=logit_bias,
                 bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
             )
         )
-        token_rows.append(np.asarray(tokens_buf).T)  # (B, S)
-        emitted_rows.append(np.asarray(emitted_buf).T)
-        if seg + 1 < n_segs:
-            if bool(np.asarray(jnp.all(done))):
-                break
-            frozen_k = (
-                tail_k if frozen_k is None
-                else jnp.concatenate([frozen_k, tail_k], axis=2)
-            )
-            frozen_v = (
-                tail_v if frozen_v is None
-                else jnp.concatenate([frozen_v, tail_v], axis=2)
-            )
+        col = seg * seg_len
+        tokens[row_map, col:col + seg_len] = np.asarray(tokens_buf).T
+        emitted[row_map, col:col + seg_len] = np.asarray(emitted_buf).T
+        if seg + 1 == n_segs:
+            break
+        done_host = np.asarray(done)
+        if done_host.all():
+            break
+        frozen_k = (
+            tail_k if frozen_k is None
+            else jnp.concatenate([frozen_k, tail_k], axis=2)
+        )
+        frozen_v = (
+            tail_v if frozen_v is None
+            else jnp.concatenate([frozen_v, tail_v], axis=2)
+        )
+        if can_compact:
+            alive = np.flatnonzero(~done_host)
+            target = batch
+            while (
+                target // 2 >= len(alive)
+                and target // 2 >= max(8, dp_align)
+                and (target // 2) % dp_align == 0
+            ):
+                target //= 2
+            if target < batch:
+                # Pad the survivor set with done rows up to the bucket
+                # (their outputs are discarded; they start done).
+                pad_rows = np.flatnonzero(done_host)[: target - len(alive)]
+                idx_host = np.concatenate([alive, pad_rows])
+                idx = jnp.asarray(idx_host)
+                row_map = row_map[idx_host]
+                take = _take_rows_keep_sharding
+                frozen_k = take(frozen_k, idx, axis=1)
+                frozen_v = take(frozen_v, idx, axis=1)
+                next_logits = take(next_logits, idx, axis=0)
+                keys = take(keys, idx, axis=0)
+                done = take(done, idx, axis=0)
+                base_pos = take(base_pos, idx, axis=0)
+                temperature = take(temperature, idx, axis=0)
+                if bias_index is not None:
+                    bias_index = take(bias_index, idx, axis=0)
+                if logit_bias is not None and jnp.ndim(logit_bias) == 2:
+                    logit_bias = take(logit_bias, idx, axis=0)
+                if not shared_layout:
+                    # Classic layout: the trunk is per-row too.
+                    trunk = jax.tree.map(
+                        lambda a: take(a, idx, axis=1)
+                        if a.ndim >= 3 else take(a, idx, axis=0),
+                        trunk,
+                    )
+                batch = target
 
-    tokens = np.full((batch, max_new_tokens), pad_id, np.int32)
-    emitted = np.zeros((batch, max_new_tokens), bool)
-    width = len(token_rows) * seg_len
-    tokens[:, :width] = np.concatenate(token_rows, axis=1)
-    emitted[:, :width] = np.concatenate(emitted_rows, axis=1)
     num_generated = emitted.sum(axis=1).astype(np.int32)
     hit_eos = num_generated < max_new_tokens
     tokens = np.where(emitted, tokens, pad_id)
@@ -389,6 +464,7 @@ def generate_tokens_shared_trunk_segmented(
     bias_index: Optional[jax.Array] = None,
     pad_id: int = 0,
     init_done: Optional[jax.Array] = None,
+    dp_align: int = 1,
 ) -> GenerateOutput:
     """``generate_tokens_shared_trunk`` as a host loop over short segments.
 
@@ -435,6 +511,7 @@ def generate_tokens_shared_trunk_segmented(
         max_new_tokens=max_new_tokens, seg_len=seg_len,
         temperature=temperature, top_k=top_k, top_p=top_p, eos_ids=eos_ids,
         bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
+        dp_align=dp_align,
     )
 
 
@@ -475,6 +552,7 @@ def generate_tokens_segmented(
     bias_table: Optional[jax.Array] = None,
     bias_index: Optional[jax.Array] = None,
     pad_id: int = 0,
+    dp_align: int = 1,
 ) -> GenerateOutput:
     """``generate_tokens`` (per-row prompts) as a host loop over segments.
 
@@ -517,6 +595,7 @@ def generate_tokens_segmented(
         temperature=temperature, top_k=top_k, top_p=top_p, eos_ids=eos_ids,
         logit_bias=logit_bias,
         bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
+        dp_align=dp_align,
     )
 
 

@@ -44,6 +44,28 @@ def test_dp_generate_matches_single_device(single, dp8):
     assert [r.token_ids for r in ours] == [r.token_ids for r in ref]
 
 
+def test_dp_segmented_generate_matches_single_device(single, dp8):
+    """Long budgets route through the segmented decode (host loop over
+    _decode_segment with frozen KV operands passed between jits) — per-row
+    results must stay identical at dp=8, sharded or replicated."""
+    requests = [
+        GenerationRequest(
+            # Identical prompts -> shared-trunk segmented; 200 buckets to
+            # 256, which segments (2x128) at the backend default ladder.
+            user_prompt="One shared draft prompt for the whole cell.",
+            max_tokens=200,
+            seed=300 + i,
+            temperature=1.0,
+        )
+        for i in range(8)
+    ]
+    for backend in (single, dp8):
+        assert backend._seg_len_for(256) is not None
+    ours = dp8.generate(requests)
+    ref = single.generate(requests)
+    assert [r.token_ids for r in ours] == [r.token_ids for r in ref]
+
+
 def test_dp_score_matches_single_device(single, dp8):
     requests = [
         ScoreRequest(context=f"Agent {i} believes trees matter.", continuation=p)

@@ -181,6 +181,47 @@ def test_classic_pad_rows_stay_done(setup):
     assert np.asarray(seg.num_generated)[1:].sum() == 0
 
 
+def test_compaction_preserves_results(setup, monkeypatch):
+    """Rows hitting EOS compact away at segment boundaries (batch 16
+    halves); per-row streams are batch-independent, so the output must
+    equal the monolithic full-batch decode row for row — AND compaction
+    must actually fire (a silently-disabled optimization would still pass
+    the equality check)."""
+    import consensus_tpu.models.generate as gen_mod
+
+    config, params, prompt, valid, _ = setup
+    batch = 16
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(5), i))(
+        jnp.arange(batch)
+    )
+    common = dict(
+        batch=batch, key=keys, max_new_tokens=MAX_NEW, pad_id=0,
+        temperature=jnp.ones((batch,), jnp.float32),
+    )
+    probe = generate_tokens_shared_trunk(params, config, prompt, valid, **common)
+    common_token = int(np.bincount(np.asarray(probe.tokens).ravel()[1:]).argmax())
+    eos = jnp.asarray([common_token], jnp.int32)
+    mono = generate_tokens_shared_trunk(
+        params, config, prompt, valid, eos_ids=eos, **common
+    )
+    seen_batches = []
+    orig_segment = gen_mod._decode_segment
+
+    def recording(*args, **kwargs):
+        seen_batches.append(kwargs["n_slots"] * kwargs["n_roles"])
+        return orig_segment(*args, **kwargs)
+
+    monkeypatch.setattr(gen_mod, "_decode_segment", recording)
+    seg = generate_tokens_shared_trunk_segmented(
+        params, config, prompt, valid, seg_len=SEG, eos_ids=eos, **common
+    )
+    assert_equal_outputs(mono, seg)
+    # Rows finish at different times AND the batch actually halved.
+    counts = np.asarray(seg.num_generated)
+    assert counts.min() < MAX_NEW and len(set(counts.tolist())) > 1
+    assert min(seen_batches) < batch, seen_batches
+
+
 def test_backend_routes_long_budgets_through_segments(monkeypatch):
     """TPUBackend: budgets >= 2*seg_len take the segmented path and produce
     the same results as the monolithic path."""
