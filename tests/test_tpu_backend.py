@@ -271,6 +271,32 @@ class TestGenerateChunking:
         finally:
             backend._session_budget.release(backend._session_budget.cap // 2)
 
+    def test_segmented_allowance_models_the_concat_peak(self):
+        """The segmented row allowance (backends/tpu.py:
+        _segmented_rows_allowed) must track the frozen-concat transient —
+        old + new frozen coexist at the last inter-segment append, the
+        per-row HBM peak for budgets >= 3 segments — while still beating
+        the monolithic allowance (whose full-budget tail is double-
+        buffered by the carry copy)."""
+        backend = self.make()
+        max_new, seg = 768, 128
+        seg_allowed = backend._segmented_rows_allowed(0, max_new, seg)
+        mono_allowed = backend._generate_rows_allowed(0, max_new)
+        # Equivalent single-buffered column count: concat peak dominates.
+        peak_cols = 2 * (max_new - seg)  # 1280 > max_new + seg = 896
+        assert seg_allowed == backend._generate_rows_allowed(
+            peak_cols - 2 * seg, seg
+        )
+        # >= (not >): the {1,1.5}x-pow2 ladder can land the 1280-col
+        # segmented and 1536-col monolithic per-row costs in one bucket
+        # for some HBM-constant combinations (code review r3).
+        assert seg_allowed >= mono_allowed
+        # 2-segment budgets have no concat (frozen = first tail directly):
+        # the in-segment peak (frozen + double-buffered live tail) governs.
+        assert backend._segmented_rows_allowed(0, 192, 96) == (
+            backend._generate_rows_allowed((192 + 96) - 2 * 96, 96)
+        )
+
     def test_oversized_batch_chunks_and_results_match(self, monkeypatch):
         from consensus_tpu.backends.base import GenerationRequest
         from consensus_tpu.backends.tpu import TPUBackend
