@@ -43,12 +43,16 @@ def main() -> None:
 
         setattr(backend, kind, timed)
 
+    overrides = {"output_dir": "/tmp/profile_combined"}
+    extra = os.environ.get("PROFILE_OVERRIDES")
+    if extra:
+        overrides.update(json.loads(extra))
     t0 = time.perf_counter()
     run_dir = run_pipeline(
         CONFIG,
         skip_comparative_ranking=True,
         skip_llm_judge=True,
-        config_overrides={"output_dir": "/tmp/profile_combined"},
+        config_overrides=overrides,
     )
     total = time.perf_counter() - t0
 
