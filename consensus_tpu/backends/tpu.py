@@ -151,6 +151,7 @@ class TPUBackend:
         pin_generation_budget: bool = False,
         segmented_decode: bool = True,
         decode_segment_len: int = 128,
+        quantize_frozen_kv: bool = False,
     ):
         self.config = config if config is not None else get_model_config(model)
         if use_flash_attention and not self.config.use_flash_attention:
@@ -189,6 +190,12 @@ class TPUBackend:
         # monolithic single-dispatch program.
         self.segmented_decode = bool(segmented_decode)
         self.decode_segment_len = max(16, int(decode_segment_len))
+        # Opt-in: store frozen decode segments as int8 KV (halves their
+        # read bytes and raises the segmented row allowance).  OFF by
+        # default — attention numerics are no longer bit-identical to the
+        # bf16 path, so enable only after an int8_delta-style welfare
+        # measurement for the workload.
+        self.quantize_frozen_kv = bool(quantize_frozen_kv)
         # Timing mode (VERDICT r2 #4): pin every generation to its full
         # max_tokens budget (no EOS early-exit, no stop-string truncation)
         # so random-weight timing runs can't flatter themselves with 1-token
@@ -493,11 +500,16 @@ class TPUBackend:
         which dominates from 3 segments up; during a segment it's
         frozen + the double-buffered seg_len live tail.
         """
-        single = (
-            prompt_width
-            + max(2 * (max_new - seg_len), max_new + seg_len)
-            - 2 * seg_len
-        )
+        peak = max(2 * (max_new - seg_len), max_new + seg_len)
+        if self.quantize_frozen_kv:
+            # int8 frozen blocks (+ ~1/hd of scale overhead) halve the
+            # frozen bytes; keep a 2*seg_len margin for the quantize
+            # transient (bf16 tail + int8 copy alive together).  The
+            # resulting 768-budget allowance is 96 rows on a 16 GB chip —
+            # the largest batch validated on hardware
+            # (scripts/decode_step_bench.py kvq arms).
+            peak = peak // 2 + 2 * seg_len
+        single = prompt_width + peak - 2 * seg_len
         return self._generate_rows_allowed(single, seg_len)
 
     def _generate_rows_allowed(self, prompt_width: int, max_new: int) -> int:
@@ -675,6 +687,7 @@ class TPUBackend:
 
             kwargs["seg_len"] = seg_len
             kwargs["dp_align"] = self._dp  # compaction keeps dp-divisible rows
+            kwargs["quantize_frozen"] = self.quantize_frozen_kv
         else:
             fn = generate_tokens_shared_trunk
         out = fn(
@@ -747,6 +760,7 @@ class TPUBackend:
 
             kwargs["seg_len"] = seg_len
             kwargs["dp_align"] = self._dp  # compaction keeps dp-divisible rows
+            kwargs["quantize_frozen"] = self.quantize_frozen_kv
         else:
             fn = generate_tokens
         out = fn(self.params, self.config, tokens, valid, keys, **kwargs)

@@ -53,6 +53,24 @@ def left_pad_positions(valid: jax.Array) -> jax.Array:
     return jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
 
 
+@jax.jit
+def _quantize_kv(arr: jax.Array):
+    """Symmetric absmax int8 over the head dim: (L, B, S, KV, hd) ->
+    (int8 same shape, float32 scale (L, B, S, KV, 1)).
+
+    Frozen segments are pure READ traffic (never written again), so
+    halving their bytes halves the dominant per-step read of long decodes
+    once the frozen region outgrows the live tail.  Per-(token, head)
+    scales keep the error structure local; the dequant convert fuses into
+    the attention dots the same way the int8 weight path's does
+    (models/quant.py MATMUL_LOWERING="astype").
+    """
+    amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    q = jnp.round(arr.astype(jnp.float32) / jnp.maximum(scale, 1e-12))
+    return q.astype(jnp.int8), scale
+
+
 def _take_rows_keep_sharding(array, idx, axis):
     """Row gather that PRESERVES the input's named sharding.
 
@@ -247,7 +265,12 @@ def _decode_segment(
         # Dedup table shipped from host; per-row bias rows gather ON device.
         logit_bias = bias_table[bias_index]
 
-    t_frozen = frozen_k.shape[2] if frozen_k is not None else 0
+    if frozen_k is None:
+        t_frozen = 0
+    elif isinstance(frozen_k, tuple):  # quantized (int8, scale) pair
+        t_frozen = frozen_k[0].shape[2]
+    else:
+        t_frozen = frozen_k.shape[2]
     frozen_positions = (
         base_pos[:, None] + 1 + jnp.arange(t_frozen)[None, :]
         if frozen_k is not None
@@ -334,6 +357,7 @@ def _segmented_loop(
     pad_id: int,
     logit_bias=None,
     dp_align: int = 1,
+    quantize_frozen: bool = False,
 ) -> GenerateOutput:
     """Host loop over ``_decode_segment`` calls shared by both layouts.
 
@@ -391,14 +415,19 @@ def _segmented_loop(
         done_host = np.asarray(done)
         if done_host.all():
             break
-        frozen_k = (
-            tail_k if frozen_k is None
-            else jnp.concatenate([frozen_k, tail_k], axis=2)
-        )
-        frozen_v = (
-            tail_v if frozen_v is None
-            else jnp.concatenate([frozen_v, tail_v], axis=2)
-        )
+        # Optionally quantize the completed segment before freezing:
+        # frozen blocks are pure read traffic, so int8 halves the dominant
+        # per-step bytes of long decodes (opt-in — attention numerics are
+        # no longer bit-identical to the bf16 path).
+        seg_k = _quantize_kv(tail_k) if quantize_frozen else tail_k
+        seg_v = _quantize_kv(tail_v) if quantize_frozen else tail_v
+        if frozen_k is None:
+            frozen_k, frozen_v = seg_k, seg_v
+        else:
+            cat = lambda old, new: jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b], axis=2), old, new
+            )
+            frozen_k, frozen_v = cat(frozen_k, seg_k), cat(frozen_v, seg_v)
         if can_compact:
             alive = np.flatnonzero(~done_host)
             target = batch
@@ -416,8 +445,12 @@ def _segmented_loop(
                 idx = jnp.asarray(idx_host)
                 row_map = row_map[idx_host]
                 take = _take_rows_keep_sharding
-                frozen_k = take(frozen_k, idx, axis=1)
-                frozen_v = take(frozen_v, idx, axis=1)
+                frozen_k = jax.tree.map(
+                    lambda a: take(a, idx, axis=1), frozen_k
+                )
+                frozen_v = jax.tree.map(
+                    lambda a: take(a, idx, axis=1), frozen_v
+                )
                 next_logits = take(next_logits, idx, axis=0)
                 keys = take(keys, idx, axis=0)
                 done = take(done, idx, axis=0)
@@ -465,6 +498,7 @@ def generate_tokens_shared_trunk_segmented(
     pad_id: int = 0,
     init_done: Optional[jax.Array] = None,
     dp_align: int = 1,
+    quantize_frozen: bool = False,
 ) -> GenerateOutput:
     """``generate_tokens_shared_trunk`` as a host loop over short segments.
 
@@ -511,7 +545,7 @@ def generate_tokens_shared_trunk_segmented(
         max_new_tokens=max_new_tokens, seg_len=seg_len,
         temperature=temperature, top_k=top_k, top_p=top_p, eos_ids=eos_ids,
         bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
-        dp_align=dp_align,
+        dp_align=dp_align, quantize_frozen=quantize_frozen,
     )
 
 
@@ -553,6 +587,7 @@ def generate_tokens_segmented(
     bias_index: Optional[jax.Array] = None,
     pad_id: int = 0,
     dp_align: int = 1,
+    quantize_frozen: bool = False,
 ) -> GenerateOutput:
     """``generate_tokens`` (per-row prompts) as a host loop over segments.
 
@@ -595,7 +630,7 @@ def generate_tokens_segmented(
         temperature=temperature, top_k=top_k, top_p=top_p, eos_ids=eos_ids,
         logit_bias=logit_bias,
         bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
-        dp_align=dp_align,
+        dp_align=dp_align, quantize_frozen=quantize_frozen,
     )
 
 

@@ -398,8 +398,8 @@ def forward_trunk_tail(
     write_col: jax.Array,  # () int32 — tail column for this step's token
     n_slots: int,
     n_roles: int,
-    frozen_k: Optional[jax.Array] = None,  # (L, Rows, F, KV, hd) read-only
-    frozen_v: Optional[jax.Array] = None,
+    frozen_k=None,  # (L, Rows, F, KV, hd) read-only — or (int8, scale) pair
+    frozen_v=None,
     frozen_positions: Optional[jax.Array] = None,  # (Rows, F) int32
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One-token decode step where every search slot shares ONE trunk cache.
@@ -431,7 +431,15 @@ def forward_trunk_tail(
     rows = tokens.shape[0]
     t_tail = tail_k.shape[2]
     has_frozen = frozen_k is not None
-    t_frozen = frozen_k.shape[2] if has_frozen else 0
+    # Quantized frozen blocks arrive as (int8 values, float32 per-(token,
+    # head) scales) pairs (generate._quantize_kv): read traffic halves and
+    # the int8->compute convert fuses into the dot operand read, mirroring
+    # the weight path (quant.py MATMUL_LOWERING="astype").
+    frozen_quantized = isinstance(frozen_k, tuple)
+    if frozen_quantized:
+        t_frozen = frozen_k[0].shape[2]
+    else:
+        t_frozen = frozen_k.shape[2] if has_frozen else 0
 
     x = take_rows(params["embed"], tokens)  # (Rows, D)
     if c.scale_embeddings:
@@ -467,7 +475,11 @@ def forward_trunk_tail(
     local_flags = jnp.asarray(c.local_flags)
 
     def layer_step(x, scanned):
-        if has_frozen:
+        k_fs = v_fs = None
+        if has_frozen and frozen_quantized:
+            (lp, k_trunk, v_trunk, k_froz, k_fs, v_froz, v_fs,
+             k_tail, v_tail, is_local) = scanned
+        elif has_frozen:
             lp, k_trunk, v_trunk, k_froz, v_froz, k_tail, v_tail, is_local = scanned
         else:
             lp, k_trunk, v_trunk, k_tail, v_tail, is_local = scanned
@@ -534,8 +546,15 @@ def forward_trunk_tail(
                 jnp.where(is_local, tail_local, tail_mask),
             ]
             if has_frozen:
-                kfg = k_froz.reshape(n_slots, n_roles, t_frozen, kv, hd)
+                kfg = (
+                    k_froz.astype(x.dtype) if frozen_quantized else k_froz
+                ).reshape(n_slots, n_roles, t_frozen, kv, hd)
                 lf = jnp.einsum("prgmd,prtgd->prgmt", qg, kfg).astype(jnp.float32)
+                if frozen_quantized:
+                    # Scales are per (row, token, head): (Rows, F, g, 1) ->
+                    # (P, R, g, 1, F) against lf's (p, r, g, m, t).
+                    sf = k_fs.reshape(n_slots, n_roles, t_frozen, kv)
+                    lf = lf * sf.transpose(0, 1, 3, 2)[:, :, :, None, :]
                 # Chronological key order [trunk, frozen, tail].
                 blocks.insert(1, lf)
                 masks.insert(1, jnp.where(is_local, frozen_local, frozen_mask))
@@ -551,11 +570,20 @@ def forward_trunk_tail(
                 "prgmt,prtgd->prgmd", weights[..., w0 + t_frozen:], vtg
             )
             if has_frozen:
-                vfg = v_froz.reshape(n_slots, n_roles, t_frozen, kv, hd)
-                attn = attn + jnp.einsum(
-                    "prgmt,prtgd->prgmd",
-                    weights[..., w0 : w0 + t_frozen], vfg,
-                )
+                vfg = (
+                    v_froz.astype(x.dtype) if frozen_quantized else v_froz
+                ).reshape(n_slots, n_roles, t_frozen, kv, hd)
+                wf = weights[..., w0 : w0 + t_frozen]
+                if frozen_quantized:
+                    # Fold the value scales into the attention weights
+                    # (f32 product, then back to compute dtype): the v dot
+                    # itself runs against the raw int8 block.
+                    sv = v_fs.reshape(n_slots, n_roles, t_frozen, kv)
+                    wf = (
+                        wf.astype(jnp.float32)
+                        * sv.transpose(0, 1, 3, 2)[:, :, :, None, :]
+                    ).astype(x.dtype)
+                attn = attn + jnp.einsum("prgmt,prtgd->prgmd", wf, vfg)
         attn = matmul(attn.reshape(rows, h * hd), lp["wo"])
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
@@ -572,7 +600,13 @@ def forward_trunk_tail(
             ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
         return x + ffn, (new_k_tail, new_v_tail)
 
-    if has_frozen:
+    if has_frozen and frozen_quantized:
+        scanned = (
+            params["layers"], trunk.k, trunk.v,
+            frozen_k[0], frozen_k[1], frozen_v[0], frozen_v[1],
+            tail_k, tail_v, local_flags,
+        )
+    elif has_frozen:
         scanned = (
             params["layers"], trunk.k, trunk.v, frozen_k, frozen_v,
             tail_k, tail_v, local_flags,

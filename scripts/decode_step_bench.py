@@ -32,7 +32,8 @@ CTX = 1024
 MODEL = "gemma2-2b"
 
 
-def run_segmented_arm(params, config, batch, max_new, seg_len, label):
+def run_segmented_arm(params, config, batch, max_new, seg_len, label,
+                      quantize_frozen=False):
     from consensus_tpu.models.generate import (
         generate_tokens_shared_trunk_segmented,
     )
@@ -50,6 +51,7 @@ def run_segmented_arm(params, config, batch, max_new, seg_len, label):
         temperature=jnp.ones((batch,), jnp.float32),
         eos_ids=jnp.asarray([-1], jnp.int32),
         pad_id=0,
+        quantize_frozen=quantize_frozen,
     )
     out = generate_tokens_shared_trunk_segmented(
         params, config, jnp.asarray(tokens), jnp.asarray(valid), **args
@@ -123,6 +125,11 @@ def main() -> None:
         # on a 16 GB chip (frozen-concat transient peak); keep arms inside
         # the production envelope.
         run_segmented_arm(params_int8, config, 48, 768, 128, "int8, SEGMENTED s=128")
+    if arms in ("all", "kvq"):
+        run_segmented_arm(params_int8, config, 64, 768, 128,
+                          "int8, SEGMENTED s=128, int8 frozen", quantize_frozen=True)
+        run_segmented_arm(params_int8, config, 96, 768, 128,
+                          "int8, SEGMENTED s=128, int8 frozen", quantize_frozen=True)
     if arms in ("all", "bf16"):
         del params_int8
         params_bf16 = init_params(config, jax.random.PRNGKey(1), dtype=jnp.bfloat16)

@@ -222,6 +222,72 @@ def test_compaction_preserves_results(setup, monkeypatch):
     assert min(seen_batches) < batch, seen_batches
 
 
+def test_quantize_kv_roundtrip_error_bounded():
+    """_quantize_kv: symmetric absmax int8 over hd — relative reconstruction
+    error is bounded by half a quantization step (~0.4% of the row max)."""
+    from consensus_tpu.models.generate import _quantize_kv
+
+    arr = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 5, 2, 16))
+    q, scale = _quantize_kv(arr)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 3, 5, 2, 1)
+    recon = q.astype(jnp.float32) * scale
+    err = np.abs(np.asarray(recon) - np.asarray(arr))
+    bound = np.asarray(scale) * 0.5 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_quantized_frozen_close_to_bf16_frozen(setup):
+    """Opt-in int8 frozen KV: not bit-identical, but the decode must stay
+    statistically faithful — most greedy tokens agree with the exact
+    path, and every row still produces a full-budget generation."""
+    config, params, prompt, valid, keys = setup
+    common = dict(
+        batch=BATCH, key=keys, max_new_tokens=MAX_NEW, pad_id=0,
+        temperature=jnp.zeros((BATCH,), jnp.float32),  # greedy
+    )
+    exact = generate_tokens_shared_trunk_segmented(
+        params, config, prompt, valid, seg_len=SEG, **common
+    )
+    quant = generate_tokens_shared_trunk_segmented(
+        params, config, prompt, valid, seg_len=SEG, quantize_frozen=True,
+        **common
+    )
+    a, b = np.asarray(exact.tokens), np.asarray(quant.tokens)
+    agreement = (a == b).mean()
+    assert agreement > 0.8, f"token agreement {agreement:.2%}"
+    # Segment 0 has no frozen context at all: its tokens are exact.
+    np.testing.assert_array_equal(a[:, :SEG], b[:, :SEG])
+    assert int(np.asarray(quant.num_generated).min()) == MAX_NEW
+
+
+def test_backend_quantized_frozen_option():
+    """TPUBackend(quantize_frozen_kv=True) serves long budgets end-to-end."""
+    backend = TPUBackend(
+        model="tiny-gemma2",
+        max_context=64,
+        base_seed=0,
+        dtype="float32",
+        decode_segment_len=32,
+        quantize_frozen_kv=True,
+    )
+    requests = [
+        GenerationRequest(
+            user_prompt="Shared long-budget prompt.",
+            max_tokens=70,
+            seed=50 + i,
+            temperature=1.0,
+        )
+        for i in range(4)
+    ]
+    results = backend.generate(requests)
+    assert all(r.ok for r in results)
+    # Strict >: the int8-frozen allowance branch must actually raise
+    # capacity (64 -> 96 rows at the 768 budget on production HBM).
+    assert backend._segmented_rows_allowed(0, 768, 128) > TPUBackend(
+        model="tiny-gemma2", max_context=64, dtype="float32"
+    )._segmented_rows_allowed(0, 768, 128)
+
+
 def test_backend_routes_long_budgets_through_segments(monkeypatch):
     """TPUBackend: budgets >= 2*seg_len take the segmented path and produce
     the same results as the monolithic path."""
