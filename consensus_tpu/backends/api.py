@@ -105,6 +105,7 @@ class APIBackend:
                     temperature=request.temperature,
                     seed=request.seed,
                     stop=list(request.stop) or None,
+                    repetition_penalty=request.repetition_penalty,
                 )
                 text = response.choices[0].message.content
             else:
@@ -120,6 +121,7 @@ class APIBackend:
                     temperature=request.temperature,
                     seed=request.seed,
                     stop=list(request.stop) or None,
+                    repetition_penalty=request.repetition_penalty,
                 )
                 text = response.choices[0].text
             return GenerationResult(text=text or "", finish_reason="stop")
@@ -272,11 +274,17 @@ class OpenAIBackend:
             kwargs = {}
             if self.json_mode and "json" in request.user_prompt.lower():
                 kwargs["response_format"] = {"type": "json_object"}
+            # Forward the request's sampling params (VERDICT r3): the judge
+            # prompts ask for up to 1,000 tokens and would otherwise be
+            # truncated at the server default; per-request seeds fall back
+            # to the reference's fixed judge seed (src/evaluation.py:462).
+            if request.max_tokens:
+                kwargs["max_tokens"] = request.max_tokens
             response = self._client.chat.completions.create(
                 model=self.model,
                 messages=messages,
-                temperature=0.0,
-                seed=JUDGE_SEED,
+                temperature=request.temperature,
+                seed=JUDGE_SEED if request.seed is None else request.seed,
                 **kwargs,
             )
             return GenerationResult(

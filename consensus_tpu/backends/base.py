@@ -47,6 +47,9 @@ class GenerationRequest:
     bias_against_tokens: Tuple[str, ...] = ()
     bias_value: float = BAN_BIAS
     chat: bool = True
+    #: HF/Together-style repetition penalty (>1 discourages repeats; the
+    #: reference forwards the same-named param, src/utils.py:88).  1.0 = off.
+    repetition_penalty: float = 1.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -151,7 +151,8 @@ class TPUBackend:
         pin_generation_budget: bool = False,
         segmented_decode: bool = True,
         decode_segment_len: int = 128,
-        quantize_frozen_kv: bool = False,
+        kv_quant: bool = True,
+        quantize_frozen_kv: Optional[bool] = None,
     ):
         self.config = config if config is not None else get_model_config(model)
         if use_flash_attention and not self.config.use_flash_attention:
@@ -190,12 +191,20 @@ class TPUBackend:
         # monolithic single-dispatch program.
         self.segmented_decode = bool(segmented_decode)
         self.decode_segment_len = max(16, int(decode_segment_len))
-        # Opt-in: store frozen decode segments as int8 KV (halves their
-        # read bytes and raises the segmented row allowance).  OFF by
-        # default — attention numerics are no longer bit-identical to the
-        # bf16 path, so enable only after an int8_delta-style welfare
-        # measurement for the workload.
-        self.quantize_frozen_kv = bool(quantize_frozen_kv)
+        self._seg_len_fallbacks: set = set()  # budgets already logged
+        # int8 generated-token KV for segmented decodes: the live tail is
+        # WRITTEN int8+scale (halving the while_loop carry the remote AOT
+        # compiler copies every step) and frozen segment blocks stay int8
+        # (halving their read bytes and roughly doubling the segmented row
+        # allowance).  ON by default — generation numerics are no longer
+        # bit-identical to the bf16 KV path (teacher-forced scoring never
+        # touches generated KV, so scores are unaffected); measured logit/
+        # token deltas: reports/kv_quant_delta.md.  ``quantize_frozen_kv``
+        # is the round-3 name for the frozen-only variant, kept as an
+        # alias so older configs keep working.
+        if quantize_frozen_kv is not None:
+            kv_quant = bool(quantize_frozen_kv)
+        self.kv_quant = bool(kv_quant)
         # Timing mode (VERDICT r2 #4): pin every generation to its full
         # max_tokens budget (no EOS early-exit, no stop-string truncation)
         # so random-weight timing runs can't flatter themselves with 1-token
@@ -486,6 +495,17 @@ class TPUBackend:
             return None
         for seg_len in (self.decode_segment_len, 96, 64):
             if max_new >= 2 * seg_len and max_new % seg_len == 0:
+                if seg_len != self.decode_segment_len:
+                    # Tell the operator (once per budget) their configured
+                    # length was unusable for this bucket — tuning runs need
+                    # to know which length actually served it (ADVICE r3).
+                    if max_new not in self._seg_len_fallbacks:
+                        self._seg_len_fallbacks.add(max_new)
+                        logger.info(
+                            "segmented decode: budget %d is not a multiple of "
+                            "decode_segment_len=%d >= 2x; using seg_len=%d",
+                            max_new, self.decode_segment_len, seg_len,
+                        )
                 return seg_len
         return None
 
@@ -494,23 +514,34 @@ class TPUBackend:
     ) -> int:
         """Row allowance for a SEGMENTED decode.
 
-        Single-buffered per-row tokens: the prompt trunk plus the frozen-KV
-        peak — during the inter-segment concatenate, old and new frozen
-        buffers coexist (2·(max_new − seg_len) columns at the last append),
-        which dominates from 3 segments up; during a segment it's
-        frozen + the double-buffered seg_len live tail.
+        Per-row KV columns at peak: the prompt trunk, the single-buffered
+        frozen blocks (max_new − seg_len columns — blocks append to a LIST,
+        so round 3's 2x concatenate transient is gone), the double-buffered
+        seg_len live tail, and one seg_len of compaction-gather transient
+        (old + gathered block rows coexist briefly).  With ``kv_quant``
+        the frozen blocks AND the live tail are int8+scale — bytes halve,
+        plus seg_len/8 of margin for the f32 scale planes (4 bytes per
+        hd=256 int8 lane group ≈ 1.6%) — and the classic-layout prompt
+        trunk is int8 too, so its decode-time cost halves; the binding
+        moment for wide prompts becomes the prefill→quantize transient
+        (bf16 + int8 trunks alive together, 1.5x the bf16 trunk).
         """
-        peak = max(2 * (max_new - seg_len), max_new + seg_len)
-        if self.quantize_frozen_kv:
-            # int8 frozen blocks (+ ~1/hd of scale overhead) halve the
-            # frozen bytes; keep a 2*seg_len margin for the quantize
-            # transient (bf16 tail + int8 copy alive together).  The
-            # resulting 768-budget allowance is 96 rows on a 16 GB chip —
-            # the largest batch validated on hardware
-            # (scripts/decode_step_bench.py kvq arms).
-            peak = peak // 2 + 2 * seg_len
-        single = prompt_width + peak - 2 * seg_len
-        return self._generate_rows_allowed(single, seg_len)
+        gen_cols = (max_new - seg_len) + 2 * seg_len + seg_len
+        if self.kv_quant:
+            # seg_len//4 margin covers the f32 scale planes plus compiler
+            # temps.  Hardware evidence at the 768/128 gemma2-2b shape: the
+            # resulting 128-row allowance ran clean (decode_step_bench r4
+            # arm, 19.4 ms/step) while a raw 192-row arm — above any
+            # allowance this model can produce on a 16 GB chip — failed
+            # remote compile on HLO temp space.
+            q_cols = (gen_cols + 1) // 2 + seg_len // 4
+            effective = max(
+                prompt_width + prompt_width // 2 + 2 * seg_len,
+                (prompt_width + 1) // 2 + prompt_width // 16 + q_cols,
+            )
+        else:
+            effective = prompt_width + gen_cols
+        return self._generate_rows_allowed(effective - 2 * seg_len, seg_len)
 
     def _generate_rows_allowed(self, prompt_width: int, max_new: int) -> int:
         """Largest decode batch whose KV cache fits HBM next to the weights.
@@ -611,6 +642,15 @@ class TPUBackend:
         temperatures = jnp.asarray(
             [r.temperature for r in requests] + [1.0] * pad_rows, jnp.float32
         )
+        # Repetition penalty: None (the overwhelmingly common case — no
+        # paper config sets it) keeps the penalty-free decode programs; any
+        # row >1 switches the batch to the presence-tracking variant.
+        penalties = [getattr(r, "repetition_penalty", 1.0) for r in requests]
+        rep_penalty = (
+            jnp.asarray(penalties + [1.0] * pad_rows, jnp.float32)
+            if any(abs(p - 1.0) > 1e-9 for p in penalties)
+            else None
+        )
         bias_table, bias_index = self._bias_table(requests)
         if bias_index is not None and pad_rows:
             bias_index = jnp.concatenate(
@@ -622,7 +662,8 @@ class TPUBackend:
         eos_ids = (
             (-1,) if self.pin_generation_budget else self.tokenizer.eos_ids
         )
-        return target, pad_rows, temperatures, bias_table, bias_index, keys, eos_ids
+        return (target, pad_rows, temperatures, bias_table, bias_index,
+                keys, eos_ids, rep_penalty)
 
     def _generate_shared(
         self, requests: Sequence[GenerationRequest], prompt_ids: List[int]
@@ -657,9 +698,8 @@ class TPUBackend:
             return out
 
         self.call_counts["generate"] += len(requests)
-        target, pad_rows, temperatures, bias_table, bias_index, keys, eos_ids = (
-            self._prep_generation_rows(requests, allowed)
-        )
+        (target, pad_rows, temperatures, bias_table, bias_index, keys,
+         eos_ids, rep_penalty) = self._prep_generation_rows(requests, allowed)
 
         pad = self.tokenizer.pad_id
         tokens = np.full((1, width), pad, np.int32)
@@ -680,6 +720,8 @@ class TPUBackend:
             pad_id=self.tokenizer.pad_id,
             init_done=jnp.asarray(init_done),
         )
+        if rep_penalty is not None:
+            kwargs["rep_penalty"] = rep_penalty
         if segmented:
             from consensus_tpu.models.generate import (
                 generate_tokens_shared_trunk_segmented as fn,
@@ -687,7 +729,7 @@ class TPUBackend:
 
             kwargs["seg_len"] = seg_len
             kwargs["dp_align"] = self._dp  # compaction keeps dp-divisible rows
-            kwargs["quantize_frozen"] = self.quantize_frozen_kv
+            kwargs["kv_quant"] = self.kv_quant
         else:
             fn = generate_tokens_shared_trunk
         out = fn(
@@ -740,9 +782,8 @@ class TPUBackend:
             return out
 
         self.call_counts["generate"] += len(requests)
-        target, pad_rows, temperatures, bias_table, bias_index, keys, eos_ids = (
-            self._prep_generation_rows(requests, allowed)
-        )
+        (target, pad_rows, temperatures, bias_table, bias_index, keys,
+         eos_ids, rep_penalty) = self._prep_generation_rows(requests, allowed)
         token_lists = list(token_lists) + [[]] * pad_rows
         tokens, valid = self._left_pad_batch(token_lists)
         kwargs = dict(
@@ -753,6 +794,8 @@ class TPUBackend:
             bias_index=bias_index,
             pad_id=self.tokenizer.pad_id,
         )
+        if rep_penalty is not None:
+            kwargs["rep_penalty"] = rep_penalty
         if segmented:
             from consensus_tpu.models.generate import (
                 generate_tokens_segmented as fn,
@@ -760,7 +803,7 @@ class TPUBackend:
 
             kwargs["seg_len"] = seg_len
             kwargs["dp_align"] = self._dp  # compaction keeps dp-divisible rows
-            kwargs["quantize_frozen"] = self.quantize_frozen_kv
+            kwargs["kv_quant"] = self.kv_quant
         else:
             fn = generate_tokens
         out = fn(self.params, self.config, tokens, valid, keys, **kwargs)

@@ -242,9 +242,10 @@ class StatementEvaluator:
                     "You are an impartial judge. Rank ALL the candidate "
                     "consensus statements below by how well each represents "
                     "this participant's opinion (rank 1 = best). Respond in "
-                    'JSON: {"reasoning": "...", "method_ranking": '
-                    '{"<method>": <rank>, ...}} using every method exactly '
-                    "once.\n\n"
+                    'JSON: {"reasoning": "...", "ranking": [<statement '
+                    "numbers, best first>], \"method_ranking\": "
+                    '{"<method>": <rank>, ...}} using every statement and '
+                    "method exactly once.\n\n"
                     f"Issue: {issue}\n\nParticipant's opinion: {opinion}\n\n"
                     f"Candidate statements:\n{numbered}"
                 ),
@@ -262,6 +263,15 @@ class StatementEvaluator:
         for (agent_name, _), response in zip(agents, responses):
             payload = _extract_json(response.text) if response.ok else None
             ranking = (payload or {}).get("method_ranking") or {}
+            if len(ranking) != len(methods):
+                # Reconstruction fallback (reference src/evaluation.py:
+                # 769-801): small local judges often emit a usable raw
+                # ``ranking`` array (statement numbers, best first, matching
+                # the prompt's 1-indexed numbering) even when the
+                # method-name map is missing or truncated.
+                ranking = _reconstruct_method_ranking(
+                    (payload or {}).get("ranking"), methods
+                ) or ranking
             reasoning_rows.append(
                 {
                     "agent": agent_name,
@@ -428,3 +438,34 @@ def _extract_json(text: str) -> Optional[Dict[str, Any]]:
         return json.loads(match.group(0))
     except json.JSONDecodeError:
         return None
+
+
+def _reconstruct_method_ranking(
+    raw_ranking: Any, methods: List[str]
+) -> Optional[Dict[str, int]]:
+    """Recover a method->rank map from the judge's raw ``ranking`` array
+    (reference src/evaluation.py:769-801).
+
+    The array lists statement numbers best-first, 1-indexed by the
+    prompt's numbering, which follows ``methods`` order; position i (also
+    1-indexed) is the rank.  Returns None unless the array has exactly one
+    entry per method and every entry maps to a distinct method — a partial
+    reconstruction is worse than an honest None (it would skew the
+    min/max/avg rank columns).
+    """
+    if not isinstance(raw_ranking, (list, tuple)):
+        return None
+    if len(raw_ranking) != len(methods):
+        return None
+    reconstructed: Dict[str, int] = {}
+    for rank, stmt_num in enumerate(raw_ranking, 1):
+        try:
+            idx = int(stmt_num) - 1
+        except (TypeError, ValueError):
+            return None
+        if not 0 <= idx < len(methods):
+            return None
+        reconstructed[methods[idx]] = rank
+    if len(reconstructed) != len(methods):
+        return None
+    return reconstructed

@@ -32,6 +32,13 @@ Config keys (reference :40-60): ``num_candidates`` (3), ``num_rounds`` (1),
 configs set ``num_retries``, so retries silently default there (SURVEY §7.4);
 we read the same key the reference code reads.  ``tie_breaking_method``
 ("random"), ``max_tokens`` (700 for CoT envelopes), ``seed``.
+
+``prompt_style`` selects the phase prompts: ``"tpu"`` (default — the house
+prompts below: shorter, cheaper to prefill, same envelope/parser contract)
+or ``"reference"`` (byte-identical reproductions of the reference's prompt
+strings, :mod:`consensus_tpu.methods.prompts_reference` — use for quality
+runs where prompt-text parity matters, VERDICT r3 #6).  Both styles flow
+through identical parsing, seeding, and Schulze aggregation.
 """
 
 from __future__ import annotations
@@ -133,6 +140,12 @@ class HabermasMachineGenerator(BaseGenerator):
         self._num_retries = int(cfg.get("num_retries_on_error", 1))
         self._tie_breaking = cfg.get("tie_breaking_method", "random")
         self._max_tokens = int(cfg.get("max_tokens", 700))
+        self._prompt_style = str(cfg.get("prompt_style", "tpu"))
+        if self._prompt_style not in ("tpu", "reference"):
+            raise ValueError(
+                f"unknown prompt_style: {self._prompt_style!r} "
+                "(expected 'tpu' or 'reference')"
+            )
         # Timing mode (experiment timing_pin_budget): random weights cannot
         # emit the CoT <answer> envelope, so without a fallback the whole
         # deliberation pipeline short-circuits after the candidate phase and
@@ -212,6 +225,51 @@ class HabermasMachineGenerator(BaseGenerator):
             + item
         )
 
+    # -- prompt-style dispatch ----------------------------------------------
+
+    def _p_draft(self, issue: str, opinions: List[str]) -> str:
+        if self._prompt_style == "reference":
+            from consensus_tpu.methods import prompts_reference as ref
+
+            return ref.initial_prompt(issue, opinions)
+        return _draft_prompt(issue, opinions)
+
+    def _p_rank(self, issue: str, opinion: str, statements: List[str]) -> str:
+        if self._prompt_style == "reference":
+            from consensus_tpu.methods import prompts_reference as ref
+
+            return ref.ranking_prompt(issue, opinion, statements)
+        return _ranking_prompt(issue, opinion, statements)
+
+    def _p_critique(self, issue: str, opinion: str, winner: str) -> str:
+        if self._prompt_style == "reference":
+            from consensus_tpu.methods import prompts_reference as ref
+
+            return ref.critique_prompt(issue, opinion, winner)
+        return _critique_prompt(issue, opinion, winner)
+
+    def _p_revision(
+        self,
+        issue: str,
+        opinions: List[str],
+        winner: str,
+        critiques: List[Optional[str]],
+    ) -> str:
+        if self._prompt_style == "reference":
+            from consensus_tpu.methods import prompts_reference as ref
+
+            # The reference builder takes dicts but reads only .values();
+            # it prints EVERY critique row (None included), unlike the
+            # house prompt which drops empty ones — that difference is part
+            # of the prompt-text contract being reproduced.
+            return ref.revision_prompt(
+                issue,
+                {str(i): op for i, op in enumerate(opinions)},
+                winner,
+                {str(i): c for i, c in enumerate(critiques)},
+            )
+        return _revision_prompt(issue, opinions, winner, critiques)
+
     # -- phases --------------------------------------------------------------
 
     def _generate_batch(
@@ -232,7 +290,7 @@ class HabermasMachineGenerator(BaseGenerator):
     def _draft_candidates(
         self, issue: str, opinions: List[str], n: int
     ) -> List[str]:
-        prompt = _draft_prompt(issue, opinions)
+        prompt = self._p_draft(issue, opinions)
         statements: List[str] = []
         for attempt in range(self._num_retries + 1):
             missing = n - len(statements)
@@ -266,7 +324,7 @@ class HabermasMachineGenerator(BaseGenerator):
             if not pending:
                 break
             prompts = [
-                _ranking_prompt(issue, agents[i][1], statements) for i in pending
+                self._p_rank(issue, agents[i][1], statements) for i in pending
             ]
             seeds = [
                 self._phase_seed("ranking", round_num, i, attempt) for i in pending
@@ -311,7 +369,7 @@ class HabermasMachineGenerator(BaseGenerator):
         round_num: int,
     ) -> List[Optional[str]]:
         prompts = [
-            _critique_prompt(issue, opinion, winner)
+            self._p_critique(issue, opinion, winner)
             for opinion in agent_opinions.values()
         ]
         seeds = [
@@ -338,7 +396,7 @@ class HabermasMachineGenerator(BaseGenerator):
     ) -> List[str]:
         """Revised candidates; failed generations fall back to the previous
         winner (reference :1476-1482)."""
-        prompt = _revision_prompt(issue, opinions, winner, critiques)
+        prompt = self._p_revision(issue, opinions, winner, critiques)
         revised: List[str] = []
         for attempt in range(self._num_retries + 1):
             missing = n - len(revised)

@@ -23,14 +23,26 @@ import jax.numpy as jnp
 from consensus_tpu.models.config import ModelConfig
 from consensus_tpu.models.sampling import sample_tokens
 from consensus_tpu.models.transformer import (
+    KVCache,
     forward,
     forward_trunk_tail,
     make_cache,
     project_logits,
 )
+from consensus_tpu.models.transformer import quantize_kv as transformer_quantize_kv
 
 
 class GenerateOutput(NamedTuple):
+    """Decode results.
+
+    Residency contract: the monolithic jitted entry points return DEVICE
+    arrays; the ``*_segmented`` host loops return HOST numpy arrays (their
+    per-segment buffers are already fetched through the tunnel — shipping
+    them back to the device would be a pointless round trip).  Consumers
+    must treat the fields as array-likes (``np.asarray`` is always safe)
+    and must NOT assume device residency.
+    """
+
     tokens: jax.Array  # (B, max_new_tokens) int32; pad_id after EOS
     num_generated: jax.Array  # (B,) int32 — tokens before (excluding) EOS
     hit_eos: jax.Array  # (B,) bool
@@ -53,22 +65,26 @@ def left_pad_positions(valid: jax.Array) -> jax.Array:
     return jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
 
 
-@jax.jit
-def _quantize_kv(arr: jax.Array):
-    """Symmetric absmax int8 over the head dim: (L, B, S, KV, hd) ->
-    (int8 same shape, float32 scale (L, B, S, KV, 1)).
+#: Shared absmax-int8 KV quantizer (transformer.quantize_kv): one
+#: implementation serves the per-step tail writes, the classic prompt
+#: trunk, and (by construction) the frozen blocks a quantized tail
+#: freezes into — the scale layouts cannot drift apart.
+_quantize_kv = jax.jit(transformer_quantize_kv)
 
-    Frozen segments are pure READ traffic (never written again), so
-    halving their bytes halves the dominant per-step read of long decodes
-    once the frozen region outgrows the live tail.  Per-(token, head)
-    scales keep the error structure local; the dequant convert fuses into
-    the attention dots the same way the int8 weight path's does
-    (models/quant.py MATMUL_LOWERING="astype").
-    """
-    amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=-1, keepdims=True)
-    scale = amax / 127.0
-    q = jnp.round(arr.astype(jnp.float32) / jnp.maximum(scale, 1e-12))
-    return q.astype(jnp.int8), scale
+
+def _prompt_presence(
+    prompt_tokens: jax.Array,  # (R, S) int32
+    prompt_valid: jax.Array,  # (R, S) bool
+    vocab_size: int,
+) -> jax.Array:
+    """(R, V) bool mask of the prompt's token ids.
+
+    Seeds the repetition-penalty seen-token mask: HF semantics (and the
+    Together param the reference forwards, src/utils.py:88) penalize
+    tokens from the prompt as well as prior generations."""
+    rows = prompt_tokens.shape[0]
+    pres = jnp.zeros((rows, vocab_size), jnp.bool_)
+    return pres.at[jnp.arange(rows)[:, None], prompt_tokens].max(prompt_valid)
 
 
 def _take_rows_keep_sharding(array, idx, axis):
@@ -108,6 +124,7 @@ def generate_tokens(
     bias_table: Optional[jax.Array] = None,  # (U, V) unique bias vectors
     bias_index: Optional[jax.Array] = None,  # (B,) int32 row -> table index
     pad_id: int = 0,
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32; None = off
 ) -> GenerateOutput:
     """Single-dispatch decode: prefill + ONE full-budget ``_decode_segment``
     (nested jit inlines, so this stays one compiled program).
@@ -124,13 +141,18 @@ def generate_tokens(
         params, config, prompt_tokens, prompt_valid
     )
     init_done = ~jnp.any(prompt_valid, axis=1)
+    presence = (
+        _prompt_presence(prompt_tokens, prompt_valid, config.vocab_size)
+        if rep_penalty is not None
+        else None
+    )
     tokens_buf, emitted_buf, *_ = _decode_segment(
         params, config, trunk, None, None, cur_pos,
         jnp.asarray(0, jnp.int32), next_logits, key, init_done,
         n_slots=1, n_roles=batch, seg_len=max_new_tokens,
         temperature=temperature, top_k=top_k, top_p=top_p, eos_ids=eos_ids,
         logit_bias=logit_bias, bias_table=bias_table, bias_index=bias_index,
-        pad_id=pad_id,
+        pad_id=pad_id, presence=presence, rep_penalty=rep_penalty,
     )
     return _assemble_output(tokens_buf, emitted_buf, max_new_tokens, pad_id)
 
@@ -155,6 +177,7 @@ def generate_tokens_shared_trunk(
     bias_index: Optional[jax.Array] = None,
     pad_id: int = 0,
     init_done: Optional[jax.Array] = None,  # (B,) bool — bucket-pad rows
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32; None = off
 ) -> GenerateOutput:
     """``generate_tokens`` for B rows sharing ONE identical prompt.
 
@@ -185,12 +208,21 @@ def generate_tokens_shared_trunk(
     cur_pos = jnp.broadcast_to(last_pos, (batch,))
     if init_done is None:
         init_done = jnp.zeros((batch,), jnp.bool_)
+    presence = (
+        jnp.broadcast_to(
+            _prompt_presence(prompt_tokens, prompt_valid, c.vocab_size),
+            (batch, c.vocab_size),
+        )
+        if rep_penalty is not None
+        else None
+    )
     tokens_buf, emitted_buf, *_ = _decode_segment(
         params, config, trunk, None, None, cur_pos,
         jnp.asarray(0, jnp.int32), next_logits, key, init_done,
         n_slots=batch, n_roles=1, seg_len=max_new_tokens,
         temperature=temperature, top_k=top_k, top_p=top_p, eos_ids=eos_ids,
         bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
+        presence=presence, rep_penalty=rep_penalty,
     )
     return _assemble_output(tokens_buf, emitted_buf, max_new_tokens, pad_id)
 
@@ -217,13 +249,14 @@ def _prefill_shared(
     jax.jit,
     static_argnames=(
         "config", "n_slots", "n_roles", "seg_len", "top_k", "top_p", "pad_id",
+        "quantize_tail",
     ),
 )
 def _decode_segment(
     params,
     config: ModelConfig,
     trunk,  # KVCache with n_roles rows (1 shared row, or one per request)
-    frozen_k,  # (L, B, F, KV, hd) or None — earlier segments' KV
+    frozen_k,  # tuple of (L, B, F_i, KV, hd) blocks (or (int8, scale) pairs)
     frozen_v,
     base_pos: jax.Array,  # (B,) int32 — per-row last prompt position
     seg_start: jax.Array,  # () int32 — tokens decoded before this segment
@@ -241,6 +274,9 @@ def _decode_segment(
     bias_table: Optional[jax.Array] = None,
     bias_index: Optional[jax.Array] = None,
     pad_id: int = 0,
+    quantize_tail: bool = False,
+    presence: Optional[jax.Array] = None,  # (B, V) bool seen-token mask
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32
 ):
     """One ``seg_len``-step slice of a decode, B = n_slots * n_roles rows.
 
@@ -248,10 +284,13 @@ def _decode_segment(
     the remote AOT compiler double-buffers the carry every step, so carry
     bytes are ~10x more expensive than operand bytes (decode_step_bench.py:
     44.6 ms/step at a 64x768 carried tail vs ~5 ms weights-bound floor).
-    Earlier segments ride in ``frozen_k/v``: read-only operands, never
-    copied.  Sampling math, PRNG folds, and masking are identical to the
-    monolithic loops — per-step logits see the same key set
-    [trunk, frozen, tail] in chronological order.
+    Earlier segments ride in ``frozen_k/v``: read-only operand BLOCKS, one
+    per frozen segment, never copied or concatenated.  With
+    ``quantize_tail`` the live tail itself is int8+scale — the carry bytes
+    halve again, and freezing a segment is a free list append.  Sampling
+    math, PRNG folds, and masking are identical to the monolithic loops —
+    per-step logits see the same key set [trunk, frozen..., tail] in
+    chronological order.
 
     Serves both decode layouts: shared-trunk (n_slots=B, n_roles=1 — every
     row broadcast-attends trunk row 0) and classic per-row trunks
@@ -265,22 +304,30 @@ def _decode_segment(
         # Dedup table shipped from host; per-row bias rows gather ON device.
         logit_bias = bias_table[bias_index]
 
-    if frozen_k is None:
-        t_frozen = 0
-    elif isinstance(frozen_k, tuple):  # quantized (int8, scale) pair
-        t_frozen = frozen_k[0].shape[2]
-    else:
-        t_frozen = frozen_k.shape[2]
-    frozen_positions = (
-        base_pos[:, None] + 1 + jnp.arange(t_frozen)[None, :]
-        if frozen_k is not None
-        else None
-    )
+    frozen_k = tuple(frozen_k) if frozen_k else ()
+    frozen_v = tuple(frozen_v) if frozen_v else ()
+    frozen_positions = []
+    offset = 0
+    for block in frozen_k:
+        width = (block[0] if isinstance(block, tuple) else block).shape[2]
+        frozen_positions.append(
+            base_pos[:, None] + 1 + offset + jnp.arange(width)[None, :]
+        )
+        offset += width
     cur_pos = base_pos + seg_start
     tail_positions = cur_pos[:, None] + 1 + jnp.arange(seg_len)[None, :]
     tail_shape = (c.n_layers, batch, seg_len, c.n_kv_heads, c.head_dim)
-    tail_k = jnp.zeros(tail_shape, params["embed"].dtype)
-    tail_v = jnp.zeros(tail_shape, params["embed"].dtype)
+    if quantize_tail:
+        scale_shape = tail_shape[:-1] + (1,)
+        tail_k = (
+            jnp.zeros(tail_shape, jnp.int8), jnp.zeros(scale_shape, jnp.float32)
+        )
+        tail_v = (
+            jnp.zeros(tail_shape, jnp.int8), jnp.zeros(scale_shape, jnp.float32)
+        )
+    else:
+        tail_k = jnp.zeros(tail_shape, params["embed"].dtype)
+        tail_v = jnp.zeros(tail_shape, params["embed"].dtype)
 
     def is_eos(token: jax.Array) -> jax.Array:
         if eos_ids.shape[0] == 0:
@@ -289,13 +336,19 @@ def _decode_segment(
 
     tokens_buf = jnp.full((seg_len, batch), pad_id, jnp.int32)
     emitted_buf = jnp.zeros((seg_len, batch), jnp.bool_)
+    # Repetition penalty needs the seen-token mask in the carry (it grows
+    # with each sampled token).  The default path (no penalty) must trace
+    # EXACTLY as before — same carry tuple, same HLO — so the mask rides as
+    # an optional tenth element, present only when the feature is on.
+    use_rp = presence is not None and rep_penalty is not None
 
     def cond(carry):
-        i, _, _, _, done, _, _, _, _ = carry
-        return (i < seg_len) & ~jnp.all(done)
+        return (carry[0] < seg_len) & ~jnp.all(carry[4])
 
     def body(carry):
-        i, next_logits, tail_k, tail_v, done, key, cur_pos, tokens_buf, emitted_buf = carry
+        (i, next_logits, tail_k, tail_v, done, key, cur_pos, tokens_buf,
+         emitted_buf) = carry[:9]
+        pres = carry[9] if use_rp else None
         if key.ndim == 2:  # per-row keys: rows draw independently
             pairs = jax.vmap(jax.random.split)(key)
             key, sub = pairs[:, 0], pairs[:, 1]
@@ -304,8 +357,12 @@ def _decode_segment(
         token = sample_tokens(
             sub, next_logits, temperature=temperature, top_k=top_k, top_p=top_p,
             logit_bias=logit_bias,
+            presence=pres, rep_penalty=rep_penalty if use_rp else None,
         )
         token = jnp.where(done, pad_id, token)
+        if use_rp:
+            # Done rows re-mark pad_id — harmless, keeps the scatter dense.
+            pres = pres.at[jnp.arange(batch), token].set(True)
         token_is_eos = is_eos(token) & ~done
         emitted = ~done & ~token_is_eos
         new_done = done | token_is_eos
@@ -315,25 +372,30 @@ def _decode_segment(
             params, config, token, pos, trunk, tail_k, tail_v,
             tail_positions, i, n_slots, n_roles,
             frozen_k=frozen_k, frozen_v=frozen_v,
-            frozen_positions=frozen_positions,
+            frozen_positions=tuple(frozen_positions),
         )
         logits = project_logits(params, config, hidden)
         tokens_buf = jax.lax.dynamic_update_slice(tokens_buf, token[None], (i, 0))
         emitted_buf = jax.lax.dynamic_update_slice(
             emitted_buf, emitted[None], (i, 0)
         )
-        return (
+        out = (
             i + 1, logits, tail_k, tail_v, new_done, key, pos,
             tokens_buf, emitted_buf,
         )
+        return out + ((pres,) if use_rp else ())
 
     init = (
         jnp.asarray(0, jnp.int32), next_logits, tail_k, tail_v,
         done, keys, cur_pos, tokens_buf, emitted_buf,
-    )
+    ) + ((presence,) if use_rp else ())
     final = jax.lax.while_loop(cond, body, init)
-    (_, next_logits, tail_k, tail_v, done, keys, _, tokens_buf, emitted_buf) = final
-    return tokens_buf, emitted_buf, next_logits, tail_k, tail_v, done, keys
+    (_, next_logits, tail_k, tail_v, done, keys, _, tokens_buf, emitted_buf) = final[:9]
+    presence = final[9] if use_rp else None
+    return (
+        tokens_buf, emitted_buf, next_logits, tail_k, tail_v, done, keys,
+        presence,
+    )
 
 
 def _segmented_loop(
@@ -357,13 +419,21 @@ def _segmented_loop(
     pad_id: int,
     logit_bias=None,
     dp_align: int = 1,
-    quantize_frozen: bool = False,
+    kv_quant: bool = False,
+    presence: Optional[jax.Array] = None,  # (B, V) bool seen-token mask
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32
 ) -> GenerateOutput:
     """Host loop over ``_decode_segment`` calls shared by both layouts.
 
     Between segments the host checks whether every row is done — real
     statements finish at a fraction of the 700-token habermas budget, so
     whole segments are skipped where a monolithic loop only skips steps.
+
+    Completed segments append to a LIST of frozen operand blocks — never
+    concatenated, so there is no append copy and no 2x frozen transient in
+    the HBM peak (round 3's single-block design made that transient the
+    row-allowance bound).  With ``kv_quant`` the live tail is written int8
+    (carry bytes halve) and freezing is a free list append.
 
     Rows that finish COMPACT away at segment boundaries — but only by
     HALVING the batch: every per-row array (and, in the classic layout,
@@ -388,14 +458,16 @@ def _segmented_loop(
     # row gathers would change them; compact only with per-row keys.
     can_compact = getattr(keys, "ndim", 0) == 2 and jnp.ndim(temperature) == 1
 
-    frozen_k = frozen_v = None
+    frozen_k: list = []
+    frozen_v: list = []
     tokens = np.full((orig_batch, max_new_tokens), pad_id, np.int32)
     emitted = np.zeros((orig_batch, max_new_tokens), bool)
     n_segs = max_new_tokens // seg_len
     for seg in range(n_segs):
-        tokens_buf, emitted_buf, next_logits, tail_k, tail_v, done, keys = (
+        (tokens_buf, emitted_buf, next_logits, tail_k, tail_v, done, keys,
+         presence) = (
             _decode_segment(
-                params, config, trunk, frozen_k, frozen_v,
+                params, config, trunk, tuple(frozen_k), tuple(frozen_v),
                 base_pos, jnp.asarray(seg * seg_len, jnp.int32),
                 next_logits, keys, done,
                 n_slots=batch if shared_layout else 1,
@@ -405,6 +477,8 @@ def _segmented_loop(
                 top_k=top_k, top_p=top_p, eos_ids=eos_ids,
                 logit_bias=logit_bias,
                 bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
+                quantize_tail=kv_quant,
+                presence=presence, rep_penalty=rep_penalty,
             )
         )
         col = seg * seg_len
@@ -415,19 +489,10 @@ def _segmented_loop(
         done_host = np.asarray(done)
         if done_host.all():
             break
-        # Optionally quantize the completed segment before freezing:
-        # frozen blocks are pure read traffic, so int8 halves the dominant
-        # per-step bytes of long decodes (opt-in — attention numerics are
-        # no longer bit-identical to the bf16 path).
-        seg_k = _quantize_kv(tail_k) if quantize_frozen else tail_k
-        seg_v = _quantize_kv(tail_v) if quantize_frozen else tail_v
-        if frozen_k is None:
-            frozen_k, frozen_v = seg_k, seg_v
-        else:
-            cat = lambda old, new: jax.tree.map(
-                lambda a, b: jnp.concatenate([a, b], axis=2), old, new
-            )
-            frozen_k, frozen_v = cat(frozen_k, seg_k), cat(frozen_v, seg_v)
+        # The finished segment's tail freezes as-is (already int8+scale
+        # under kv_quant) — a list append, no copy, no quantize dispatch.
+        frozen_k.append(tail_k)
+        frozen_v.append(tail_v)
         if can_compact:
             alive = np.flatnonzero(~done_host)
             target = batch
@@ -460,6 +525,10 @@ def _segmented_loop(
                     bias_index = take(bias_index, idx, axis=0)
                 if logit_bias is not None and jnp.ndim(logit_bias) == 2:
                     logit_bias = take(logit_bias, idx, axis=0)
+                if presence is not None:
+                    presence = take(presence, idx, axis=0)
+                if rep_penalty is not None:
+                    rep_penalty = take(rep_penalty, idx, axis=0)
                 if not shared_layout:
                     # Classic layout: the trunk is per-row too.
                     trunk = jax.tree.map(
@@ -498,7 +567,8 @@ def generate_tokens_shared_trunk_segmented(
     pad_id: int = 0,
     init_done: Optional[jax.Array] = None,
     dp_align: int = 1,
-    quantize_frozen: bool = False,
+    kv_quant: bool = False,
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32; None = off
 ) -> GenerateOutput:
     """``generate_tokens_shared_trunk`` as a host loop over short segments.
 
@@ -538,6 +608,14 @@ def generate_tokens_shared_trunk_segmented(
     done = (
         jnp.zeros((batch,), jnp.bool_) if init_done is None else init_done
     )
+    presence = (
+        jnp.broadcast_to(
+            _prompt_presence(prompt_tokens, prompt_valid, c.vocab_size),
+            (batch, c.vocab_size),
+        )
+        if rep_penalty is not None
+        else None
+    )
     return _segmented_loop(
         params, config, trunk, jnp.broadcast_to(last_pos, (batch,)),
         next_logits, key, done,
@@ -545,7 +623,8 @@ def generate_tokens_shared_trunk_segmented(
         max_new_tokens=max_new_tokens, seg_len=seg_len,
         temperature=temperature, top_k=top_k, top_p=top_p, eos_ids=eos_ids,
         bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
-        dp_align=dp_align, quantize_frozen=quantize_frozen,
+        dp_align=dp_align, kv_quant=kv_quant,
+        presence=presence, rep_penalty=rep_penalty,
     )
 
 
@@ -587,7 +666,8 @@ def generate_tokens_segmented(
     bias_index: Optional[jax.Array] = None,
     pad_id: int = 0,
     dp_align: int = 1,
-    quantize_frozen: bool = False,
+    kv_quant: bool = False,
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32; None = off
 ) -> GenerateOutput:
     """``generate_tokens`` (per-row prompts) as a host loop over segments.
 
@@ -619,9 +699,28 @@ def generate_tokens_segmented(
     next_logits, trunk, last_pos = _prefill_classic(
         params, config, prompt_tokens, prompt_valid
     )
+    if kv_quant:
+        # The per-row prompt cache is the dominant per-step read of a
+        # classic-layout decode (B rows x ctx columns, re-read every step);
+        # it is written once at prefill and read-only after, so int8 halves
+        # the read with the same per-(token, head) scale scheme as the
+        # frozen blocks.  (The shared-trunk layout skips this: its trunk is
+        # ONE row — quantizing it saves ~nothing and would add a program
+        # variant.)
+        trunk = KVCache(
+            k=_quantize_kv(trunk.k),
+            v=_quantize_kv(trunk.v),
+            key_positions=trunk.key_positions,
+            key_valid=trunk.key_valid,
+        )
     # Bucket-padding dummy rows (no valid prompt tokens) start done —
     # matches generate_tokens' init_done.
     done = ~jnp.any(prompt_valid, axis=1)
+    presence = (
+        _prompt_presence(prompt_tokens, prompt_valid, config.vocab_size)
+        if rep_penalty is not None
+        else None
+    )
     return _segmented_loop(
         params, config, trunk, last_pos,
         next_logits, key, done,
@@ -630,7 +729,8 @@ def generate_tokens_segmented(
         temperature=temperature, top_k=top_k, top_p=top_p, eos_ids=eos_ids,
         logit_bias=logit_bias,
         bias_table=bias_table, bias_index=bias_index, pad_id=pad_id,
-        dp_align=dp_align, quantize_frozen=quantize_frozen,
+        dp_align=dp_align, kv_quant=kv_quant,
+        presence=presence, rep_penalty=rep_penalty,
     )
 
 

@@ -36,6 +36,22 @@ def _top_p_filter(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < threshold, -jnp.inf, logits)
 
 
+def apply_repetition_penalty(
+    logits: jax.Array,  # (B, V) float32
+    presence: jax.Array,  # (B, V) bool — token ids seen in prompt/output
+    penalty: jax.Array,  # (B,) or scalar float32, 1.0 = no-op
+) -> jax.Array:
+    """HF-style repetition penalty: for already-seen tokens, positive
+    logits divide by the penalty and negative logits multiply by it
+    (the reference forwards the same-named Together param,
+    src/utils.py:88,156,184 — identical semantics server-side)."""
+    penalty = jnp.asarray(penalty, jnp.float32)
+    if penalty.ndim == 1:
+        penalty = penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(presence, penalized, logits)
+
+
 @functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
 def sample_tokens(
     key: jax.Array,  # single key (2,) or per-row keys (B, 2)
@@ -44,6 +60,8 @@ def sample_tokens(
     top_k: int = 0,
     top_p: float = 1.0,
     logit_bias: Optional[jax.Array] = None,  # (V,) or (B, V) additive
+    presence: Optional[jax.Array] = None,  # (B, V) bool seen-token mask
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32
 ) -> jax.Array:
     """Sample one token id per row; temperature<=0 means greedy argmax.
 
@@ -52,6 +70,8 @@ def sample_tokens(
     the reference's per-request seed semantics (SURVEY §7.4).
     """
     logits = logits.astype(jnp.float32)
+    if presence is not None and rep_penalty is not None:
+        logits = apply_repetition_penalty(logits, presence, rep_penalty)
     if logit_bias is not None:
         logits = logits + logit_bias
 

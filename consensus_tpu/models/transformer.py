@@ -386,22 +386,37 @@ def forward(
     return project_logits(params, c, x), new_cache
 
 
+def quantize_kv(arr: jax.Array):
+    """Symmetric absmax int8 over the head (last) dim, shape-agnostic:
+    (..., hd) -> (int8 same shape, float32 scale (..., 1)).
+
+    The SINGLE quantizer for every generated-KV surface — per-step tail
+    writes here, whole prompt trunks and frozen blocks via
+    generate._quantize_kv (an alias of this function) — so the
+    per-(token, head) scale layout can never drift between the tail and
+    the frozen blocks it turns into."""
+    amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    q = jnp.round(arr.astype(jnp.float32) / jnp.maximum(scale, 1e-12))
+    return q.astype(jnp.int8), scale
+
+
 def forward_trunk_tail(
     params: Params,
     config: ModelConfig,
     tokens: jax.Array,  # (Rows,) int32 — one new token per (slot x role) row
     positions: jax.Array,  # (Rows,) int32 — RoPE position of the new token
     trunk: KVCache,  # (L, R0, W0, ...) shared read-only prefix, R0 = n_roles
-    tail_k: jax.Array,  # (L, Rows, Ts, KV, hd) per-row generated-token keys
-    tail_v: jax.Array,
+    tail_k,  # (L, Rows, Ts, KV, hd) per-row generated keys — or (int8, scale)
+    tail_v,
     tail_positions: jax.Array,  # (Rows, Ts) int32
     write_col: jax.Array,  # () int32 — tail column for this step's token
     n_slots: int,
     n_roles: int,
-    frozen_k=None,  # (L, Rows, F, KV, hd) read-only — or (int8, scale) pair
-    frozen_v=None,
-    frozen_positions: Optional[jax.Array] = None,  # (Rows, F) int32
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    frozen_k=(),  # sequence of (L, Rows, F_i, KV, hd) blocks / (int8, scale)
+    frozen_v=(),
+    frozen_positions=(),  # sequence of (Rows, F_i) int32, one per block
+):
     """One-token decode step where every search slot shares ONE trunk cache.
 
     Beam-search slots all contain the identical prompt prefix — replicating
@@ -414,32 +429,41 @@ def forward_trunk_tail(
     state.  Tail columns <= ``write_col`` are visible (the current token
     writes there first).
 
-    ``frozen_*``: an optional second read-only KV source holding tokens the
-    row generated in EARLIER decode segments (models/generate.py's
-    segmented decode).  The live tail rides the while_loop carry, which the
-    remote AOT compiler double-buffers — copying the full (Rows, Ts) tail
-    every step dominates long decodes (measured 44 ms/step at 64x768 vs a
-    ~6 ms roofline, scripts/decode_step_bench.py).  Frozen columns are a
-    plain operand: read once per step by attention, never copied, and
-    always fully visible (segments append whole seg_len blocks).
+    ``frozen_*``: optional read-only KV blocks holding tokens the row
+    generated in EARLIER decode segments (models/generate.py's segmented
+    decode), one block per frozen segment, in chronological order.  The
+    live tail rides the while_loop carry, which the remote AOT compiler
+    double-buffers — copying the full (Rows, Ts) tail every step dominates
+    long decodes (measured 44 ms/step at 64x768 vs a ~6 ms roofline,
+    scripts/decode_step_bench.py).  Frozen blocks are plain operands: read
+    once per step by attention, never copied, never concatenated (the
+    per-block list replaces round 3's single concatenated block, whose
+    append transient dominated the segmented HBM row allowance), and always
+    fully visible (segments append whole seg_len blocks).
 
-    Returns (final-norm hidden (Rows, D), new tail_k, new tail_v).
+    A block — and the live tail itself — may be an (int8 values, float32
+    per-(token, head) scales) pair: read traffic and carry bytes halve, and
+    the int8->compute convert fuses into the attention dot's operand read,
+    mirroring the weight path (quant.py MATMUL_LOWERING="astype").  A
+    quantized tail is written quantized (one absmax round per step) so
+    freezing a segment is a free list append.
+
+    Returns (final-norm hidden (Rows, D), new tail_k, new tail_v) with the
+    tail structure preserved.
     """
     c = config
     h, kv, hd = c.n_heads, c.n_kv_heads, c.head_dim
     reps = h // kv
     rows = tokens.shape[0]
-    t_tail = tail_k.shape[2]
-    has_frozen = frozen_k is not None
-    # Quantized frozen blocks arrive as (int8 values, float32 per-(token,
-    # head) scales) pairs (generate._quantize_kv): read traffic halves and
-    # the int8->compute convert fuses into the dot operand read, mirroring
-    # the weight path (quant.py MATMUL_LOWERING="astype").
-    frozen_quantized = isinstance(frozen_k, tuple)
-    if frozen_quantized:
-        t_frozen = frozen_k[0].shape[2]
-    else:
-        t_frozen = frozen_k.shape[2] if has_frozen else 0
+    frozen_k = tuple(frozen_k)
+    frozen_v = tuple(frozen_v)
+    frozen_positions = tuple(frozen_positions)
+    tail_quantized = isinstance(tail_k, tuple)
+    trunk_quantized = isinstance(trunk.k, tuple)
+    t_tail = (tail_k[0] if tail_quantized else tail_k).shape[2]
+
+    def block_width(block) -> int:
+        return (block[0] if isinstance(block, tuple) else block).shape[2]
 
     x = take_rows(params["embed"], tokens)  # (Rows, D)
     if c.scale_embeddings:
@@ -462,27 +486,25 @@ def forward_trunk_tail(
         trunk_local = trunk_mask
         tail_local = jnp.broadcast_to(tail_fill, (n_slots, n_roles, t_tail))
     tail_mask = jnp.broadcast_to(tail_fill, (n_slots, n_roles, t_tail))
-    if has_frozen:
-        # Frozen columns are always fully valid — segments append exactly
-        # seg_len columns each (generate.py) — so only the sliding window
-        # ever masks them.
-        frozen_mask = jnp.ones((n_slots, n_roles, t_frozen), bool)
+    # Frozen columns are always fully valid — segments append exactly
+    # seg_len columns each (generate.py) — so only the sliding window
+    # ever masks them.  Widths come from the UNsliced (L, Rows, F, ...)
+    # blocks here; inside the layer scan the leading layer axis is gone.
+    frozen_widths = [block_width(b) for b in frozen_k]
+    frozen_masks = []
+    frozen_locals = []
+    for width, fp in zip(frozen_widths, frozen_positions):
+        mask = jnp.ones((n_slots, n_roles, width), bool)
+        frozen_masks.append(mask)
         if c.sliding_window is not None:
-            frozen_kp = frozen_positions.reshape(n_slots, n_roles, t_frozen)
-            frozen_local = qp[:, :, None] - frozen_kp < c.sliding_window
+            fkp = fp.reshape(n_slots, n_roles, width)
+            frozen_locals.append(qp[:, :, None] - fkp < c.sliding_window)
         else:
-            frozen_local = frozen_mask
+            frozen_locals.append(mask)
     local_flags = jnp.asarray(c.local_flags)
 
     def layer_step(x, scanned):
-        k_fs = v_fs = None
-        if has_frozen and frozen_quantized:
-            (lp, k_trunk, v_trunk, k_froz, k_fs, v_froz, v_fs,
-             k_tail, v_tail, is_local) = scanned
-        elif has_frozen:
-            lp, k_trunk, v_trunk, k_froz, v_froz, k_tail, v_tail, is_local = scanned
-        else:
-            lp, k_trunk, v_trunk, k_tail, v_tail, is_local = scanned
+        lp, k_trunk, v_trunk, froz_k, froz_v, k_tail, v_tail, is_local = scanned
 
         attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
         q = matmul(attn_in, lp["wq"]).reshape(rows, 1, h, hd)
@@ -491,14 +513,31 @@ def forward_trunk_tail(
         q = apply_rope(q, positions[:, None], c.rope_theta, c.rope_scaling)
         k = apply_rope(k, positions[:, None], c.rope_theta, c.rope_scaling)
 
-        new_k_tail = jax.lax.dynamic_update_slice(
-            k_tail, k, (0, write_col, 0, 0)
-        )
-        new_v_tail = jax.lax.dynamic_update_slice(
-            v_tail, v, (0, write_col, 0, 0)
-        )
+        if tail_quantized:
+            qk, ks = quantize_kv(k)
+            qv, vs = quantize_kv(v)
+            new_k_tail = (
+                jax.lax.dynamic_update_slice(k_tail[0], qk, (0, write_col, 0, 0)),
+                jax.lax.dynamic_update_slice(k_tail[1], ks, (0, write_col, 0, 0)),
+            )
+            new_v_tail = (
+                jax.lax.dynamic_update_slice(v_tail[0], qv, (0, write_col, 0, 0)),
+                jax.lax.dynamic_update_slice(v_tail[1], vs, (0, write_col, 0, 0)),
+            )
+        else:
+            new_k_tail = jax.lax.dynamic_update_slice(
+                k_tail, k, (0, write_col, 0, 0)
+            )
+            new_v_tail = jax.lax.dynamic_update_slice(
+                v_tail, v, (0, write_col, 0, 0)
+            )
 
-        if c.use_decode_attention and not has_frozen:
+        if (
+            c.use_decode_attention
+            and not frozen_k
+            and not tail_quantized
+            and not trunk_quantized
+        ):
             # Fused pallas kernel (ops/decode_attention.py): one VMEM pass
             # per (role, kv-head) instead of four einsums with an fp32
             # logits intermediate.  Session call sites guarantee per-role
@@ -534,56 +573,91 @@ def forward_trunk_tail(
             attn = attn.astype(x.dtype)
         else:
             qg = q.reshape(n_slots, n_roles, kv, reps, hd)
-            ktg = new_k_tail.reshape(n_slots, n_roles, t_tail, kv, hd)
-            vtg = new_v_tail.reshape(n_slots, n_roles, t_tail, kv, hd)
+
+            def key_logits(block, width):
+                """(P,R,g,m,width) attention logits for one generated-KV
+                block, dequantizing int8 via the per-(token, head) scale."""
+                quantized = isinstance(block, tuple)
+                values = block[0] if quantized else block
+                kg = values.astype(x.dtype).reshape(
+                    n_slots, n_roles, width, kv, hd
+                )
+                lg = jnp.einsum("prgmd,prtgd->prgmt", qg, kg).astype(jnp.float32)
+                if quantized:
+                    # Scales are per (row, token, head): (Rows, F, g, 1) ->
+                    # (P, R, g, 1, F) against lg's (p, r, g, m, t).
+                    s = block[1].reshape(n_slots, n_roles, width, kv)
+                    lg = lg * s.transpose(0, 1, 3, 2)[:, :, :, None, :]
+                return lg
+
+            def value_attend(block, width, w):
+                """Weighted value sum for one generated-KV block; value
+                scales fold into the f32 weights, the dot runs int8."""
+                quantized = isinstance(block, tuple)
+                values = block[0] if quantized else block
+                vg = values.astype(x.dtype).reshape(
+                    n_slots, n_roles, width, kv, hd
+                )
+                if quantized:
+                    s = block[1].reshape(n_slots, n_roles, width, kv)
+                    w = (
+                        w.astype(jnp.float32)
+                        * s.transpose(0, 1, 3, 2)[:, :, :, None, :]
+                    ).astype(x.dtype)
+                return jnp.einsum("prgmt,prtgd->prgmd", w, vg)
 
             # Trunk attention broadcasts the shared (R, W0) keys over slots.
-            lt = jnp.einsum("prgmd,rtgd->prgmt", qg, k_trunk).astype(jnp.float32)
-            ls = jnp.einsum("prgmd,prtgd->prgmt", qg, ktg).astype(jnp.float32)
-            blocks = [lt, ls]
-            masks = [
-                jnp.where(is_local, trunk_local, trunk_mask),
-                jnp.where(is_local, tail_local, tail_mask),
-            ]
-            if has_frozen:
-                kfg = (
-                    k_froz.astype(x.dtype) if frozen_quantized else k_froz
-                ).reshape(n_slots, n_roles, t_frozen, kv, hd)
-                lf = jnp.einsum("prgmd,prtgd->prgmt", qg, kfg).astype(jnp.float32)
-                if frozen_quantized:
-                    # Scales are per (row, token, head): (Rows, F, g, 1) ->
-                    # (P, R, g, 1, F) against lf's (p, r, g, m, t).
-                    sf = k_fs.reshape(n_slots, n_roles, t_frozen, kv)
-                    lf = lf * sf.transpose(0, 1, 3, 2)[:, :, :, None, :]
-                # Chronological key order [trunk, frozen, tail].
-                blocks.insert(1, lf)
-                masks.insert(1, jnp.where(is_local, frozen_local, frozen_mask))
+            # A quantized trunk (classic-layout segmented decodes under
+            # kv_quant: the per-row prompt cache is the dominant per-step
+            # read) dequantizes exactly like the generated-KV blocks, with
+            # the (R, W0, kv) scales broadcast over slots.
+            if trunk_quantized:
+                lt = jnp.einsum(
+                    "prgmd,rtgd->prgmt", qg, k_trunk[0].astype(x.dtype)
+                ).astype(jnp.float32)
+                st = k_trunk[1][..., 0]  # (R, W0, kv)
+                lt = lt * st.transpose(0, 2, 1)[None, :, :, None, :]
+            else:
+                lt = jnp.einsum(
+                    "prgmd,rtgd->prgmt", qg, k_trunk
+                ).astype(jnp.float32)
+            # Chronological key order [trunk, frozen blocks..., tail].
+            widths = frozen_widths + [t_tail]
+            blocks = [lt] + [
+                key_logits(b, w) for b, w in zip(froz_k, frozen_widths)
+            ] + [key_logits(new_k_tail, t_tail)]
+            masks = (
+                [jnp.where(is_local, trunk_local, trunk_mask)]
+                + [
+                    jnp.where(is_local, fl, fm)
+                    for fl, fm in zip(frozen_locals, frozen_masks)
+                ]
+                + [jnp.where(is_local, tail_local, tail_mask)]
+            )
             logits = jnp.concatenate(blocks, axis=-1) * c.q_scale
             logits = _softcap(logits, c.attn_softcap)
             mask = jnp.concatenate(masks, axis=-1)[:, :, None, None]
             logits = jnp.where(mask, logits, MASK_FILL)
             weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-            w0 = k_trunk.shape[1]
-            attn = jnp.einsum(
-                "prgmt,rtgd->prgmd", weights[..., :w0], v_trunk
-            ) + jnp.einsum(
-                "prgmt,prtgd->prgmd", weights[..., w0 + t_frozen:], vtg
-            )
-            if has_frozen:
-                vfg = (
-                    v_froz.astype(x.dtype) if frozen_quantized else v_froz
-                ).reshape(n_slots, n_roles, t_frozen, kv, hd)
-                wf = weights[..., w0 : w0 + t_frozen]
-                if frozen_quantized:
-                    # Fold the value scales into the attention weights
-                    # (f32 product, then back to compute dtype): the v dot
-                    # itself runs against the raw int8 block.
-                    sv = v_fs.reshape(n_slots, n_roles, t_frozen, kv)
-                    wf = (
-                        wf.astype(jnp.float32)
-                        * sv.transpose(0, 1, 3, 2)[:, :, :, None, :]
-                    ).astype(x.dtype)
-                attn = attn + jnp.einsum("prgmt,prtgd->prgmd", wf, vfg)
+            w0 = (k_trunk[0] if trunk_quantized else k_trunk).shape[1]
+            wt = weights[..., :w0]
+            if trunk_quantized:
+                sv = v_trunk[1][..., 0]  # (R, W0, kv)
+                wt = (
+                    wt.astype(jnp.float32)
+                    * sv.transpose(0, 2, 1)[None, :, :, None, :]
+                ).astype(x.dtype)
+                attn = jnp.einsum(
+                    "prgmt,rtgd->prgmd", wt, v_trunk[0].astype(x.dtype)
+                )
+            else:
+                attn = jnp.einsum("prgmt,rtgd->prgmd", wt, v_trunk)
+            offset = w0
+            for block, width in zip(tuple(froz_v) + (new_v_tail,), widths):
+                attn = attn + value_attend(
+                    block, width, weights[..., offset : offset + width]
+                )
+                offset += width
         attn = matmul(attn.reshape(rows, h * hd), lp["wo"])
         if c.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
@@ -600,19 +674,13 @@ def forward_trunk_tail(
             ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
         return x + ffn, (new_k_tail, new_v_tail)
 
-    if has_frozen and frozen_quantized:
-        scanned = (
-            params["layers"], trunk.k, trunk.v,
-            frozen_k[0], frozen_k[1], frozen_v[0], frozen_v[1],
-            tail_k, tail_v, local_flags,
-        )
-    elif has_frozen:
-        scanned = (
-            params["layers"], trunk.k, trunk.v, frozen_k, frozen_v,
-            tail_k, tail_v, local_flags,
-        )
-    else:
-        scanned = (params["layers"], trunk.k, trunk.v, tail_k, tail_v, local_flags)
+    # One scanned pytree serves every variant: lax.scan slices each leaf
+    # along the layer axis, including nested (int8, scale) pairs and the
+    # per-block frozen tuples.
+    scanned = (
+        params["layers"], trunk.k, trunk.v, frozen_k, frozen_v,
+        tail_k, tail_v, local_flags,
+    )
     x, (new_tail_k, new_tail_v) = jax.lax.scan(layer_step, x, scanned)
     x = rms_norm(x, params["final_norm"], c.rms_eps, c.rmsnorm_style)
     return x, new_tail_k, new_tail_v

@@ -33,7 +33,7 @@ MODEL = "gemma2-2b"
 
 
 def run_segmented_arm(params, config, batch, max_new, seg_len, label,
-                      quantize_frozen=False):
+                      kv_quant=False):
     from consensus_tpu.models.generate import (
         generate_tokens_shared_trunk_segmented,
     )
@@ -51,7 +51,7 @@ def run_segmented_arm(params, config, batch, max_new, seg_len, label,
         temperature=jnp.ones((batch,), jnp.float32),
         eos_ids=jnp.asarray([-1], jnp.int32),
         pad_id=0,
-        quantize_frozen=quantize_frozen,
+        kv_quant=kv_quant,
     )
     out = generate_tokens_shared_trunk_segmented(
         params, config, jnp.asarray(tokens), jnp.asarray(valid), **args
@@ -120,16 +120,69 @@ def main() -> None:
     if arms in ("all", "seg"):
         run_segmented_arm(params_int8, config, 64, 768, 128, "int8, SEGMENTED s=128")
         run_segmented_arm(params_int8, config, 64, 768, 96, "int8, SEGMENTED s=96")
-        # NOTE: B=96 at T=768 OOMs when driven RAW like this — the backend's
-        # _generate_rows_allowed caps segmented 768-budget batches at 64 rows
-        # on a 16 GB chip (frozen-concat transient peak); keep arms inside
-        # the production envelope.
+        # Round 3's frozen-concat transient OOMed raw B=96 at T=768; the
+        # round-4 block-list design (no concat) lifts the bf16 allowance to
+        # ~96 and the int8-KV allowance to ~192 on a 16 GB chip.
         run_segmented_arm(params_int8, config, 48, 768, 128, "int8, SEGMENTED s=128")
     if arms in ("all", "kvq"):
         run_segmented_arm(params_int8, config, 64, 768, 128,
-                          "int8, SEGMENTED s=128, int8 frozen", quantize_frozen=True)
+                          "int8, SEGMENTED s=128, int8 KV", kv_quant=True)
         run_segmented_arm(params_int8, config, 96, 768, 128,
-                          "int8, SEGMENTED s=128, int8 frozen", quantize_frozen=True)
+                          "int8, SEGMENTED s=128, int8 KV", kv_quant=True)
+    if arms == "r4c":
+        # Classic layout (per-row prompt trunks — habermas ranking/critique
+        # phases): the B x ctx trunk is the dominant per-step read; under
+        # kv_quant it is int8 after prefill.
+        from consensus_tpu.models.generate import generate_tokens_segmented
+
+        def run_classic(batch, kv_quant, label):
+            tokens = np.asarray(
+                jax.random.randint(
+                    jax.random.PRNGKey(2), (batch, CTX), 1, 255, jnp.int32
+                )
+            )
+            valid = np.ones((batch, CTX), bool)
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i)
+            )(jnp.arange(batch))
+            args = dict(
+                key=keys, max_new_tokens=768, seg_len=128,
+                temperature=jnp.ones((batch,), jnp.float32),
+                eos_ids=jnp.asarray([-1], jnp.int32), pad_id=0,
+                kv_quant=kv_quant,
+            )
+            out = generate_tokens_segmented(
+                params_int8, config, jnp.asarray(tokens), jnp.asarray(valid), **args
+            )
+            np.asarray(out.tokens)
+            t0 = time.perf_counter()
+            out = generate_tokens_segmented(
+                params_int8, config, jnp.asarray(tokens), jnp.asarray(valid), **args
+            )
+            np.asarray(out.tokens)
+            wall = time.perf_counter() - t0
+            print(
+                f"{label:44s} B={batch:3d} T= 768 "
+                f"wall={wall:7.2f}s  {1000 * wall / 768:7.2f} ms/step"
+            )
+
+        run_classic(32, False, "int8, CLASSIC SEGMENTED s=128")
+        run_classic(32, True, "int8, CLASSIC SEGMENTED s=128, int8 KV+trunk")
+        run_classic(48, True, "int8, CLASSIC SEGMENTED s=128, int8 KV+trunk")
+    if arms == "r4":
+        # Round-4 arms: per-ROW throughput is the metric that moves the
+        # sweep (weights amortize over rows); the block-list + int8-tail
+        # allowance admits 192 rows at the 768 budget.
+        run_segmented_arm(params_int8, config, 64, 768, 128,
+                          "int8, SEGMENTED s=128 (r4 blocks)")
+        run_segmented_arm(params_int8, config, 64, 768, 128,
+                          "int8, SEGMENTED s=128, int8 KV", kv_quant=True)
+        run_segmented_arm(params_int8, config, 96, 768, 128,
+                          "int8, SEGMENTED s=128, int8 KV", kv_quant=True)
+        run_segmented_arm(params_int8, config, 128, 768, 128,
+                          "int8, SEGMENTED s=128, int8 KV", kv_quant=True)
+        run_segmented_arm(params_int8, config, 192, 768, 128,
+                          "int8, SEGMENTED s=128, int8 KV", kv_quant=True)
     if arms in ("all", "bf16"):
         del params_int8
         params_bf16 = init_params(config, jax.random.PRNGKey(1), dtype=jnp.bfloat16)

@@ -147,6 +147,65 @@ class TestEvaluator:
         assert len(reasoning) == len(OPINIONS)
         assert matrix["methods"] == list(statements)
 
+    def test_ranking_reconstruction_fallback(self):
+        """A judge emitting only the raw ``ranking`` array (no method map)
+        still yields full rank columns — the reference's reconstruction
+        fallback (src/evaluation.py:769-801): array entries are 1-indexed
+        statement numbers in prompt order, array position is the rank."""
+        import json
+
+        from consensus_tpu.backends.base import GenerationResult
+
+        class ArrayOnlyJudge:
+            name = "array-only"
+
+            def generate(self, requests):
+                return [
+                    GenerationResult(
+                        text=json.dumps(
+                            {"reasoning": "because", "ranking": [2, 3, 1]}
+                        )
+                    )
+                    for _ in requests
+                ]
+
+        evaluator = StatementEvaluator(
+            backend=FakeBackend(), judge_backend=ArrayOnlyJudge()
+        )
+        statements = {
+            "zero_shot": "A.",
+            "best_of_n (n=3)": "B.",
+            "habermas_machine": "C.",
+        }
+        frame, _, _ = evaluator.evaluate_comparative_rankings(
+            statements, ISSUE, OPINIONS, seed=7
+        )
+        by_key = frame.set_index("method_with_params")
+        # ranking [2, 3, 1]: statement 2 is rank 1, 3 is rank 2, 1 is rank 3.
+        for name in OPINIONS:
+            assert by_key.loc["best_of_n (n=3)", f"rank_{name}"] == 1
+            assert by_key.loc["habermas_machine", f"rank_{name}"] == 2
+            assert by_key.loc["zero_shot", f"rank_{name}"] == 3
+
+    @pytest.mark.parametrize(
+        "raw, expected",
+        [
+            ([2, 3, 1], {"m0": 3, "m1": 1, "m2": 2}),
+            ([1, 2, 3], {"m0": 1, "m1": 2, "m2": 3}),
+            (["2", "3", "1"], {"m0": 3, "m1": 1, "m2": 2}),  # numeric strings
+            ([1, 2], None),  # wrong length
+            ([1, 1, 2], None),  # duplicate statement
+            ([0, 1, 2], None),  # out-of-range (1-indexed)
+            ([1, 2, "x"], None),  # non-numeric
+            ("123", None),  # not an array
+            (None, None),
+        ],
+    )
+    def test_reconstruct_method_ranking(self, raw, expected):
+        from consensus_tpu.evaluation import _reconstruct_method_ranking
+
+        assert _reconstruct_method_ranking(raw, ["m0", "m1", "m2"]) == expected
+
     def test_results_file_layout(self, tmp_path, evaluator):
         experiment = Experiment(base_config(tmp_path))
         experiment.run()

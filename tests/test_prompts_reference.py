@@ -1,0 +1,109 @@
+"""prompt_style=reference: byte-equality against the reference sources.
+
+The reference-faithful prompt builders (methods/prompts_reference.py) are a
+behavioral contract: their value is EXACT textual identity with the
+reference's habermas prompts.  Where the reference tree is mounted, these
+tests extract the reference's own prompt functions (pure f-string builders)
+with ast + exec and pin byte-equality on real scenario inputs.
+"""
+
+import ast
+import pathlib
+
+import pytest
+
+from consensus_tpu.methods import prompts_reference as ref_prompts
+
+REFERENCE = pathlib.Path("/root/reference/src/methods/habermas_machine.py")
+
+ISSUE = "Should the library extend its opening hours?"
+OPINIONS = [
+    "Students need late-night study space.",
+    'Staff costs must stay within the current budget, "strictly".',
+    "Open later on weekends only.\n",
+]
+STATEMENTS = [
+    "  Extend hours modestly. ",
+    '"Open late on weekends."',
+    "Pilot extended hours within budget.",
+]
+
+
+@pytest.fixture(scope="module")
+def reference_fns():
+    if not REFERENCE.exists():
+        pytest.skip("reference tree not mounted")
+    tree = ast.parse(REFERENCE.read_text())
+    wanted = {
+        "_generate_initial_prompt",
+        "_hm_generate_opinion_only_ranking_prompt",
+        "_generate_critique_prompt",
+        "_generate_revised_statement_prompt",
+    }
+    namespace: dict = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name in wanted:
+            source = ast.get_source_segment(REFERENCE.read_text(), node)
+            exec(compile(source, str(REFERENCE), "exec"), namespace)
+    missing = wanted - set(namespace)
+    if missing:
+        pytest.skip(f"reference functions not found: {missing}")
+    return namespace
+
+
+def test_initial_prompt_matches(reference_fns):
+    assert ref_prompts.initial_prompt(ISSUE, OPINIONS) == reference_fns[
+        "_generate_initial_prompt"
+    ](ISSUE, OPINIONS)
+
+
+def test_ranking_prompt_matches(reference_fns):
+    assert ref_prompts.ranking_prompt(ISSUE, OPINIONS[0], STATEMENTS) == (
+        reference_fns["_hm_generate_opinion_only_ranking_prompt"](
+            ISSUE, OPINIONS[0], STATEMENTS
+        )
+    )
+
+
+def test_critique_prompt_matches(reference_fns):
+    assert ref_prompts.critique_prompt(ISSUE, OPINIONS[1], STATEMENTS[0]) == (
+        reference_fns["_generate_critique_prompt"](ISSUE, OPINIONS[1], STATEMENTS[0])
+    )
+
+
+def test_revision_prompt_matches(reference_fns):
+    opinions = {f"Agent {i}": op for i, op in enumerate(OPINIONS)}
+    critiques = {f"Agent {i}": f"Critique {i}" for i in range(len(OPINIONS))}
+    critiques["Agent 1"] = None  # the reference prints None rows verbatim
+    assert ref_prompts.revision_prompt(
+        ISSUE, opinions, STATEMENTS[2], critiques
+    ) == reference_fns["_generate_revised_statement_prompt"](
+        ISSUE, opinions, STATEMENTS[2], critiques
+    )
+
+
+def test_prompt_style_selectable_end_to_end():
+    """Both styles run the full deliberation on the fake backend; an unknown
+    style raises."""
+    from consensus_tpu.backends.fake import FakeBackend
+    from consensus_tpu.methods.habermas import HabermasMachineGenerator
+
+    opinions = {f"Agent {i + 1}": op for i, op in enumerate(OPINIONS)}
+    results = {}
+    for style in ("tpu", "reference"):
+        gen = HabermasMachineGenerator(
+            backend=FakeBackend(),
+            config={
+                "num_candidates": 2,
+                "num_rounds": 1,
+                "seed": 5,
+                "prompt_style": style,
+            },
+        )
+        results[style] = gen.generate_statement(ISSUE, opinions)
+        assert results[style] and not results[style].startswith("[ERROR")
+    gen = HabermasMachineGenerator(
+        backend=FakeBackend(), config={"prompt_style": "nope", "seed": 1}
+    )
+    with pytest.raises(ValueError):
+        gen.generate_statement(ISSUE, opinions)

@@ -1,0 +1,131 @@
+"""Repetition penalty: HF/Together semantics on the TPU decode paths.
+
+The reference forwards a ``repetition_penalty`` param to the Together API
+(src/utils.py:88,156,184; finite_lookahead.py:332 passes 1.0) — parity
+requires honoring it when set.  On device it is a presence-masked logit
+transform inside the decode loop (models/sampling.apply_repetition_penalty)
+with the seen-token mask seeded from the prompt and updated per step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.base import GenerationRequest
+from consensus_tpu.backends.tpu import TPUBackend
+from consensus_tpu.models.config import get_model_config
+from consensus_tpu.models.generate import (
+    generate_tokens,
+    generate_tokens_segmented,
+    generate_tokens_shared_trunk,
+    generate_tokens_shared_trunk_segmented,
+)
+from consensus_tpu.models.sampling import apply_repetition_penalty
+from consensus_tpu.models.transformer import init_params
+
+BATCH = 4
+CTX = 32
+MAX_NEW = 64
+SEG = 16
+
+
+def test_penalty_math():
+    """Seen positive logits divide by the penalty, seen negative multiply;
+    unseen logits are untouched."""
+    logits = jnp.asarray([[2.0, -2.0, 1.0, -1.0]])
+    presence = jnp.asarray([[True, True, False, False]])
+    out = np.asarray(
+        apply_repetition_penalty(logits, presence, jnp.asarray([2.0]))
+    )
+    np.testing.assert_allclose(out, [[1.0, -4.0, 1.0, -1.0]])
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = get_model_config("tiny-gemma2", vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (1, CTX), 1, config.vocab_size, jnp.int32
+    )
+    valid = jnp.ones((1, CTX), bool)
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(7), i))(
+        jnp.arange(BATCH)
+    )
+    return config, params, prompt, valid, keys
+
+
+def _repeat_fraction(tokens: np.ndarray) -> float:
+    """Mean fraction of steps that emit an already-emitted token."""
+    fracs = []
+    for row in tokens:
+        seen, repeats = set(), 0
+        for tok in row:
+            repeats += tok in seen
+            seen.add(tok)
+        fracs.append(repeats / max(len(row), 1))
+    return float(np.mean(fracs))
+
+
+def test_penalty_reduces_repeats_and_paths_agree(setup):
+    """A strong penalty measurably cuts token repetition on a greedy
+    decode (random tiny models loop hard without it), and the monolithic
+    and segmented paths implement identical penalty semantics."""
+    config, params, prompt, valid, keys = setup
+    common = dict(
+        batch=BATCH, key=keys, max_new_tokens=MAX_NEW, pad_id=0,
+        temperature=jnp.zeros((BATCH,), jnp.float32),  # greedy
+    )
+    plain = generate_tokens_shared_trunk(
+        params, config, prompt, valid, **common
+    )
+    rp = jnp.full((BATCH,), 8.0, jnp.float32)
+    mono = generate_tokens_shared_trunk(
+        params, config, prompt, valid, rep_penalty=rp, **common
+    )
+    seg = generate_tokens_shared_trunk_segmented(
+        params, config, prompt, valid, seg_len=SEG, rep_penalty=rp, **common
+    )
+    np.testing.assert_array_equal(np.asarray(mono.tokens), np.asarray(seg.tokens))
+    assert _repeat_fraction(np.asarray(mono.tokens)) < _repeat_fraction(
+        np.asarray(plain.tokens)
+    )
+
+
+def test_classic_paths_agree(setup):
+    config, params, prompt, valid, keys = setup
+    prompts = jnp.tile(prompt, (BATCH, 1))
+    valids = jnp.tile(valid, (BATCH, 1))
+    rp = jnp.full((BATCH,), 4.0, jnp.float32)
+    common = dict(
+        key=keys, max_new_tokens=MAX_NEW, pad_id=0,
+        temperature=jnp.ones((BATCH,), jnp.float32),
+        rep_penalty=rp,
+    )
+    mono = generate_tokens(params, config, prompts, valids, **common)
+    seg = generate_tokens_segmented(
+        params, config, prompts, valids, seg_len=SEG, **common
+    )
+    np.testing.assert_array_equal(np.asarray(mono.tokens), np.asarray(seg.tokens))
+
+
+def test_backend_accepts_repetition_penalty():
+    backend = TPUBackend(
+        model="tiny-gemma2", max_context=64, base_seed=0, dtype="float32",
+        decode_segment_len=32,
+    )
+    requests = [
+        GenerationRequest(
+            user_prompt="Draft prompt.", max_tokens=70, seed=3 + i,
+            temperature=1.0, repetition_penalty=1.3,
+        )
+        for i in range(4)
+    ]
+    results = backend.generate(requests)
+    assert all(r.ok for r in results)
+    # Penalty-free requests keep rep_penalty out of the decode kwargs
+    # entirely (no new compiled program variants on the default path).
+    *_, rep = backend._prep_generation_rows(
+        [GenerationRequest(user_prompt="x", max_tokens=8)], allowed=8
+    )
+    assert rep is None

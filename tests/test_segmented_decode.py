@@ -236,10 +236,11 @@ def test_quantize_kv_roundtrip_error_bounded():
     assert (err <= bound).all()
 
 
-def test_quantized_frozen_close_to_bf16_frozen(setup):
-    """Opt-in int8 frozen KV: not bit-identical, but the decode must stay
-    statistically faithful — most greedy tokens agree with the exact
-    path, and every row still produces a full-budget generation."""
+def test_kv_quant_close_to_bf16(setup):
+    """int8 generated-token KV (the production default): not bit-identical,
+    but the decode must stay statistically faithful — most greedy tokens
+    agree with the exact path, and every row still produces a full-budget
+    generation."""
     config, params, prompt, valid, keys = setup
     common = dict(
         batch=BATCH, key=keys, max_new_tokens=MAX_NEW, pad_id=0,
@@ -249,27 +250,50 @@ def test_quantized_frozen_close_to_bf16_frozen(setup):
         params, config, prompt, valid, seg_len=SEG, **common
     )
     quant = generate_tokens_shared_trunk_segmented(
-        params, config, prompt, valid, seg_len=SEG, quantize_frozen=True,
+        params, config, prompt, valid, seg_len=SEG, kv_quant=True,
         **common
     )
     a, b = np.asarray(exact.tokens), np.asarray(quant.tokens)
     agreement = (a == b).mean()
     assert agreement > 0.8, f"token agreement {agreement:.2%}"
-    # Segment 0 has no frozen context at all: its tokens are exact.
-    np.testing.assert_array_equal(a[:, :SEG], b[:, :SEG])
     assert int(np.asarray(quant.num_generated).min()) == MAX_NEW
 
 
-def test_backend_quantized_frozen_option():
-    """TPUBackend(quantize_frozen_kv=True) serves long budgets end-to-end."""
+def test_kv_quant_classic_trunk_close_to_bf16(setup):
+    """Classic layout under kv_quant additionally quantizes the per-row
+    prompt trunk (the dominant per-step read at production widths); the
+    decode must stay statistically faithful to the exact path."""
+    config, params, prompt, valid, keys = setup
+    prompts = jnp.tile(prompt, (BATCH, 1))
+    valids = jnp.tile(valid, (BATCH, 1))
+    common = dict(
+        key=keys, max_new_tokens=MAX_NEW, pad_id=0,
+        temperature=jnp.zeros((BATCH,), jnp.float32),  # greedy
+    )
+    exact = generate_tokens_segmented(
+        params, config, prompts, valids, seg_len=SEG, **common
+    )
+    quant = generate_tokens_segmented(
+        params, config, prompts, valids, seg_len=SEG, kv_quant=True, **common
+    )
+    a, b = np.asarray(exact.tokens), np.asarray(quant.tokens)
+    agreement = (a == b).mean()
+    assert agreement > 0.8, f"token agreement {agreement:.2%}"
+    assert int(np.asarray(quant.num_generated).min()) == MAX_NEW
+
+
+def test_backend_kv_quant_option():
+    """TPUBackend(kv_quant=True), the default, serves long budgets
+    end-to-end; the round-3 ``quantize_frozen_kv`` name still works as an
+    alias."""
     backend = TPUBackend(
         model="tiny-gemma2",
         max_context=64,
         base_seed=0,
         dtype="float32",
         decode_segment_len=32,
-        quantize_frozen_kv=True,
     )
+    assert backend.kv_quant  # production default is ON
     requests = [
         GenerationRequest(
             user_prompt="Shared long-budget prompt.",
@@ -281,16 +305,22 @@ def test_backend_quantized_frozen_option():
     ]
     results = backend.generate(requests)
     assert all(r.ok for r in results)
-    # Strict >: the int8-frozen allowance branch must actually raise
-    # capacity (64 -> 96 rows at the 768 budget on production HBM).
+    # Strict >: the int8-KV allowance branch must actually raise capacity
+    # (96 -> 192 rows at the 768 budget on production HBM).
     assert backend._segmented_rows_allowed(0, 768, 128) > TPUBackend(
-        model="tiny-gemma2", max_context=64, dtype="float32"
+        model="tiny-gemma2", max_context=64, dtype="float32", kv_quant=False
     )._segmented_rows_allowed(0, 768, 128)
+    # The deprecated alias maps onto the same switch, both ways.
+    assert not TPUBackend(
+        model="tiny-gemma2", max_context=64, dtype="float32",
+        quantize_frozen_kv=False,
+    ).kv_quant
 
 
 def test_backend_routes_long_budgets_through_segments(monkeypatch):
     """TPUBackend: budgets >= 2*seg_len take the segmented path and produce
-    the same results as the monolithic path."""
+    the same results as the monolithic path (kv_quant off — the int8-KV
+    default is deliberately not token-exact vs monolithic)."""
     def build(segmented):
         return TPUBackend(
             model="tiny-gemma2",
@@ -299,6 +329,7 @@ def test_backend_routes_long_budgets_through_segments(monkeypatch):
             dtype="float32",
             segmented_decode=segmented,
             decode_segment_len=32,
+            kv_quant=False,
         )
 
     requests = [

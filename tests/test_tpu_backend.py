@@ -271,30 +271,47 @@ class TestGenerateChunking:
         finally:
             backend._session_budget.release(backend._session_budget.cap // 2)
 
-    def test_segmented_allowance_models_the_concat_peak(self):
+    def test_segmented_allowance_models_the_block_peak(self):
         """The segmented row allowance (backends/tpu.py:
-        _segmented_rows_allowed) must track the frozen-concat transient —
-        old + new frozen coexist at the last inter-segment append, the
-        per-row HBM peak for budgets >= 3 segments — while still beating
-        the monolithic allowance (whose full-budget tail is double-
-        buffered by the carry copy)."""
-        backend = self.make()
+        _segmented_rows_allowed) tracks the block-list HBM peak — the
+        single-buffered frozen blocks (no concat transient: segments
+        append to a list), the double-buffered live tail, and one seg_len
+        of compaction-gather transient; int8 KV halves the column cost
+        (plus a scale-plane margin) — while beating the monolithic
+        allowance (whose full-budget tail is double-buffered by the
+        carry copy)."""
+        backend = self.make()  # kv_quant defaults ON
+        exact = self.make(kv_quant=False)
         max_new, seg = 768, 128
-        seg_allowed = backend._segmented_rows_allowed(0, max_new, seg)
-        mono_allowed = backend._generate_rows_allowed(0, max_new)
-        # Equivalent single-buffered column count: concat peak dominates.
-        peak_cols = 2 * (max_new - seg)  # 1280 > max_new + seg = 896
-        assert seg_allowed == backend._generate_rows_allowed(
-            peak_cols - 2 * seg, seg
+        cols = (max_new - seg) + 2 * seg + seg  # frozen + dbuf tail + gather
+        assert exact._segmented_rows_allowed(0, max_new, seg) == (
+            exact._generate_rows_allowed(cols - 2 * seg, seg)
         )
-        # >= (not >): the {1,1.5}x-pow2 ladder can land the 1280-col
-        # segmented and 1536-col monolithic per-row costs in one bucket
-        # for some HBM-constant combinations (code review r3).
-        assert seg_allowed >= mono_allowed
-        # 2-segment budgets have no concat (frozen = first tail directly):
-        # the in-segment peak (frozen + double-buffered live tail) governs.
-        assert backend._segmented_rows_allowed(0, 192, 96) == (
-            backend._generate_rows_allowed((192 + 96) - 2 * 96, 96)
+        quant_cols = (cols + 1) // 2 + seg // 4
+        assert backend._segmented_rows_allowed(0, max_new, seg) == (
+            backend._generate_rows_allowed(quant_cols - 2 * seg, seg)
+        )
+        # int8 KV must raise capacity, and both must beat monolithic.
+        assert backend._segmented_rows_allowed(0, max_new, seg) > (
+            exact._segmented_rows_allowed(0, max_new, seg)
+        )
+        assert exact._segmented_rows_allowed(0, max_new, seg) >= (
+            exact._generate_rows_allowed(0, max_new)
+        )
+        # Classic layout (wide per-row prompt trunk): under kv_quant the
+        # trunk is int8 at decode time, but the prefill→quantize transient
+        # (1.5x bf16 trunk) is the binding peak at production widths.
+        width = 1024
+        quant_cols = (cols + 1) // 2 + seg // 4
+        expected = max(
+            width + width // 2 + 2 * seg,
+            (width + 1) // 2 + width // 16 + quant_cols,
+        )
+        assert backend._segmented_rows_allowed(width, max_new, seg) == (
+            backend._generate_rows_allowed(expected - 2 * seg, seg)
+        )
+        assert backend._segmented_rows_allowed(width, max_new, seg) >= (
+            exact._segmented_rows_allowed(width, max_new, seg)
         )
 
     def test_oversized_batch_chunks_and_results_match(self, monkeypatch):
