@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import pathlib
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -232,6 +233,56 @@ class TPUBackend:
         )
         if params is not None:
             self.params = params
+        elif checkpoint and (pathlib.Path(checkpoint) / "ingest.json").exists():
+            # Pre-converted orbax checkpoint (cli/ingest_checkpoint.py):
+            # leaves restore straight to the default device in their stored
+            # (possibly already-int8) form — no host conversion pass, no
+            # 5-10 min quantize on every process start.
+            import json as _json
+
+            from consensus_tpu.models.quant import quantize_params
+            from consensus_tpu.utils.checkpoint import restore_params
+
+            meta = _json.loads(
+                (pathlib.Path(checkpoint) / "ingest.json").read_text()
+            )
+            # The manifest must agree with this backend's settings: a
+            # silently-mismatched restore either builds a wrong eval_shape
+            # template (cryptic orbax failure) or — worse — lands an
+            # unquantized 8-9B bf16 tree straight on a 16 GB chip.
+            mismatches = []
+            if meta.get("model") and meta["model"] != self.config.name:
+                mismatches.append(
+                    f"model: ingested {meta['model']!r} vs backend "
+                    f"{self.config.name!r}"
+                )
+            if meta.get("dtype") and meta["dtype"] != dtype:
+                mismatches.append(
+                    f"dtype: ingested {meta['dtype']!r} vs backend {dtype!r}"
+                )
+            ingested_quant = meta.get("quantization") or None
+            wanted_quant = quantization if quantization != "none" else None
+            if ingested_quant != wanted_quant:
+                mismatches.append(
+                    f"quantization: ingested {ingested_quant!r} vs backend "
+                    f"{wanted_quant!r} — re-run cli/ingest_checkpoint with "
+                    "the matching --quantization"
+                )
+            if mismatches:
+                raise ValueError(
+                    f"ingested checkpoint {checkpoint} does not match this "
+                    "backend: " + "; ".join(mismatches)
+                )
+            template = jax.eval_shape(
+                lambda: quantize_params(
+                    init_params(self.config, jax.random.PRNGKey(0), jax_dtype)
+                )
+                if meta.get("quantization") == "int8"
+                else init_params(self.config, jax.random.PRNGKey(0), jax_dtype)
+            )
+            self.params = restore_params(
+                str(pathlib.Path(checkpoint) / "params"), template
+            )
         elif checkpoint:
             from consensus_tpu.models.loader import load_params
 

@@ -146,6 +146,7 @@ class HabermasMachineGenerator(BaseGenerator):
                 f"unknown prompt_style: {self._prompt_style!r} "
                 "(expected 'tpu' or 'reference')"
             )
+        self._bind_prompts()
         # Timing mode (experiment timing_pin_budget): random weights cannot
         # emit the CoT <answer> envelope, so without a fallback the whole
         # deliberation pipeline short-circuits after the candidate phase and
@@ -227,48 +228,31 @@ class HabermasMachineGenerator(BaseGenerator):
 
     # -- prompt-style dispatch ----------------------------------------------
 
-    def _p_draft(self, issue: str, opinions: List[str]) -> str:
+    def _bind_prompts(self) -> None:
+        """Resolve ``prompt_style`` into the four phase-prompt builders
+        once per statement.  The reference revision builder takes dicts but
+        reads only ``.values()`` and prints EVERY critique row (None
+        included), unlike the house prompt which drops empty ones — that
+        difference is part of the prompt-text contract being reproduced."""
         if self._prompt_style == "reference":
             from consensus_tpu.methods import prompts_reference as ref
 
-            return ref.initial_prompt(issue, opinions)
-        return _draft_prompt(issue, opinions)
-
-    def _p_rank(self, issue: str, opinion: str, statements: List[str]) -> str:
-        if self._prompt_style == "reference":
-            from consensus_tpu.methods import prompts_reference as ref
-
-            return ref.ranking_prompt(issue, opinion, statements)
-        return _ranking_prompt(issue, opinion, statements)
-
-    def _p_critique(self, issue: str, opinion: str, winner: str) -> str:
-        if self._prompt_style == "reference":
-            from consensus_tpu.methods import prompts_reference as ref
-
-            return ref.critique_prompt(issue, opinion, winner)
-        return _critique_prompt(issue, opinion, winner)
-
-    def _p_revision(
-        self,
-        issue: str,
-        opinions: List[str],
-        winner: str,
-        critiques: List[Optional[str]],
-    ) -> str:
-        if self._prompt_style == "reference":
-            from consensus_tpu.methods import prompts_reference as ref
-
-            # The reference builder takes dicts but reads only .values();
-            # it prints EVERY critique row (None included), unlike the
-            # house prompt which drops empty ones — that difference is part
-            # of the prompt-text contract being reproduced.
-            return ref.revision_prompt(
-                issue,
-                {str(i): op for i, op in enumerate(opinions)},
-                winner,
-                {str(i): c for i, c in enumerate(critiques)},
+            self._p_draft = ref.initial_prompt
+            self._p_rank = ref.ranking_prompt
+            self._p_critique = ref.critique_prompt
+            self._p_revision = lambda issue, opinions, winner, critiques: (
+                ref.revision_prompt(
+                    issue,
+                    {str(i): op for i, op in enumerate(opinions)},
+                    winner,
+                    {str(i): c for i, c in enumerate(critiques)},
+                )
             )
-        return _revision_prompt(issue, opinions, winner, critiques)
+        else:
+            self._p_draft = _draft_prompt
+            self._p_rank = _ranking_prompt
+            self._p_critique = _critique_prompt
+            self._p_revision = _revision_prompt
 
     # -- phases --------------------------------------------------------------
 

@@ -1,0 +1,40 @@
+"""Shared model-FLOPs-utilization accounting.
+
+One implementation for the three reporting surfaces (bench.py, the
+north-star timing report, and the scoring microbench) so the formula and
+peak constants cannot drift apart.  Accounting convention: useful FLOPs =
+``2 * params * useful_token`` where useful tokens are generated + scored
+tokens actually consumed by a caller — bucket padding, KV/weight HBM
+traffic, host time, and tunnel RTTs all show up as LOST utilization,
+which is the point of the number.  The embedding matrix counts once (it
+is a gather on the way in and the head matmul on the way out).
+"""
+
+from __future__ import annotations
+
+#: v5e per-chip bf16 peak (the bench hardware; int8 peak is 2x this).
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+def param_count(config) -> int:
+    """Logical parameter count from a ModelConfig (quantization-agnostic)."""
+    c = config
+    attn = c.d_model * (c.n_heads * c.head_dim) * 2  # wq + wo
+    attn += c.d_model * (c.n_kv_heads * c.head_dim) * 2  # wk + wv
+    ffn = 3 * c.d_model * c.ffn_hidden  # gate, up, down
+    norms = (4 if c.use_post_norms else 2) * c.d_model
+    per_layer = attn + ffn + norms
+    total = c.n_layers * per_layer + c.vocab_size * c.d_model + c.d_model
+    if not c.tie_lm_head:
+        total += c.vocab_size * c.d_model
+    return int(total)
+
+
+def useful_tflops_per_sec(n_params: int, tokens: int, wall_s: float) -> float:
+    if wall_s <= 0:
+        return 0.0
+    return 2.0 * n_params * tokens / wall_s / 1e12
+
+
+def pct_of_peak(tflops: float, peak: float = V5E_BF16_PEAK_TFLOPS) -> float:
+    return 100.0 * tflops / peak

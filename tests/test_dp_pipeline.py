@@ -1,0 +1,81 @@
+"""dp=8 FULL-pipeline pin (VERDICT r3 #7).
+
+tests/test_dp_serving.py pins protocol-level dp=8 == dp=1; this extends
+the proof to the SWEEP surface: one north-star config (its real structure
+— habermas + best_of_n Cartesian grids, tpu backend, shared scoring —
+shrunk to test scale and pointed at the tiny model so 8 virtual CPU
+devices finish in test time) runs through the full
+``run_experiment_with_eval`` pipeline at dp=8 and dp=1, and every
+artifact CSV must agree: results.csv statements byte-identical, every
+evaluation metric column equal to float precision.  With this pinned, the
+"~N/8 wall at dp=8" projection rests on an executed end-to-end path.
+"""
+
+import pathlib
+
+import pandas as pd
+import yaml
+
+NORTH_STAR = pathlib.Path("configs/north_star/gemma/scenario_1/habermas_vs_best_of_n.yaml")
+
+
+def _run(tmp_path, dp: int) -> pathlib.Path:
+    from consensus_tpu.cli.run_experiment_with_eval import run_pipeline
+
+    config = yaml.safe_load(NORTH_STAR.read_text())
+    # Test-scale: tiny model on the virtual CPU mesh; the STRUCTURE (both
+    # methods, list-valued grids, shared scoring, seeds) is the config's.
+    config["num_seeds"] = 2
+    config["backend_options"].update(
+        {"model": "tiny-gemma2", "dtype": "float32", "max_context": 256,
+         "quantization": None, "dp": dp}
+    )
+    config["models"] = {
+        "generation_model": "tiny-gemma2",
+        "evaluation_models": ["tiny-gemma2"],
+    }
+    config["best_of_n"].update({"n": [1, 3], "max_tokens": 24})
+    config["habermas_machine"].update(
+        {"num_candidates": [1, 2], "max_tokens": 48}
+    )
+    config["experiment_name"] = f"dp_pipeline_dp{dp}"
+    config["output_dir"] = str(tmp_path / f"dp{dp}")
+    cfg_path = tmp_path / f"dp{dp}.yaml"
+    cfg_path.write_text(yaml.safe_dump(config))
+    return pathlib.Path(run_pipeline(str(cfg_path), skip_comparative_ranking=True))
+
+
+def test_dp8_pipeline_artifacts_match_dp1(tmp_path):
+    run_dp1 = _run(tmp_path, 1)
+    run_dp8 = _run(tmp_path, 8)
+
+    a = pd.read_csv(run_dp1 / "results.csv")
+    b = pd.read_csv(run_dp8 / "results.csv")
+    pd.testing.assert_frame_equal(
+        a.drop(columns=["generation_time_s"]),
+        b.drop(columns=["generation_time_s"]),
+    )
+
+    for seed_dir in sorted((run_dp1 / "evaluation" / "tiny-gemma2").iterdir()):
+        eval_a = pd.read_csv(seed_dir / "evaluation_results.csv")
+        eval_b = pd.read_csv(
+            run_dp8 / "evaluation" / "tiny-gemma2" / seed_dir.name
+            / "evaluation_results.csv"
+        )
+        drop = [c for c in eval_a.columns if c.endswith("_time_s")]
+        pd.testing.assert_frame_equal(
+            eval_a.drop(columns=drop), eval_b.drop(columns=drop),
+            check_exact=False, atol=1e-6, rtol=1e-6,
+        )
+
+    agg_a = pd.read_csv(
+        run_dp1 / "evaluation" / "improved_aggregate" / "aggregated_metrics.csv"
+    )
+    agg_b = pd.read_csv(
+        run_dp8 / "evaluation" / "improved_aggregate" / "aggregated_metrics.csv"
+    )
+    drop = [c for c in agg_a.columns if "time" in c]
+    pd.testing.assert_frame_equal(
+        agg_a.drop(columns=drop), agg_b.drop(columns=drop),
+        check_exact=False, atol=1e-6, rtol=1e-6,
+    )

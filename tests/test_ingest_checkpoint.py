@@ -1,0 +1,87 @@
+"""Checkpoint ingest (HF dir -> quantized orbax) and backend restore.
+
+The ingest command is the only step between a real mounted checkpoint and
+a sweep (VERDICT r3 #2); these tests pin the full loop on a synthetic
+checkpoint with the production key schema: HF save_pretrained dir ->
+``ingest()`` -> ``TPUBackend(checkpoint=<ingested>)`` restore, asserting
+the restored backend scores/generates identically to one loading the raw
+HF directory.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+pytest.importorskip("orbax.checkpoint")
+
+from consensus_tpu.backends.base import GenerationRequest, ScoreRequest  # noqa: E402
+from consensus_tpu.backends.tpu import TPUBackend  # noqa: E402
+from consensus_tpu.cli.ingest_checkpoint import ingest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    from tests.test_hf_numerics import _hf_tiny_gemma2, _save_hf_model
+
+    return _save_hf_model(_hf_tiny_gemma2(), tmp_path_factory.mktemp("hf"))
+
+
+def test_ingest_writes_manifest_and_params(hf_dir, tmp_path):
+    out = ingest(hf_dir, str(tmp_path / "ingested"), model="tiny-gemma2",
+                 quantization="int8", dtype="float32")
+    assert (out / "ingest.json").exists()
+    assert (out / "params").exists()
+    import json
+
+    meta = json.loads((out / "ingest.json").read_text())
+    assert meta["model"] == "tiny-gemma2"
+    assert meta["quantization"] == "int8"
+
+
+def test_restored_backend_matches_hf_loaded(hf_dir, tmp_path):
+    out = ingest(hf_dir, str(tmp_path / "ingested"), model="tiny-gemma2",
+                 quantization="int8", dtype="float32")
+    direct = TPUBackend(
+        model="tiny-gemma2", checkpoint=hf_dir, dtype="float32",
+        quantization="int8", max_context=128,
+    )
+    restored = TPUBackend(
+        model="tiny-gemma2", checkpoint=str(out), dtype="float32",
+        quantization="int8", max_context=128,
+    )
+    from consensus_tpu.models.quant import is_quantized
+
+    assert is_quantized(restored.params)  # restored already int8, no re-pass
+
+    score_req = [ScoreRequest(context="The town", continuation=" voted today")]
+    a = direct.score(score_req)[0]
+    b = restored.score(score_req)[0]
+    np.testing.assert_allclose(a.logprobs, b.logprobs, atol=1e-5)
+
+    gen_req = [
+        GenerationRequest(
+            user_prompt="Hi", max_tokens=8, temperature=0.0, seed=1
+        )
+    ]
+    assert direct.generate(gen_req)[0].token_ids == (
+        restored.generate(gen_req)[0].token_ids
+    )
+
+
+def test_unquantized_ingest_roundtrip(hf_dir, tmp_path):
+    out = ingest(hf_dir, str(tmp_path / "plain"), model="tiny-gemma2",
+                 quantization="none", dtype="float32")
+    restored = TPUBackend(
+        model="tiny-gemma2", checkpoint=str(out), dtype="float32",
+        max_context=128,
+    )
+    direct = TPUBackend(
+        model="tiny-gemma2", checkpoint=hf_dir, dtype="float32",
+        max_context=128,
+    )
+    req = [ScoreRequest(context="Alpha", continuation=" beta gamma")]
+    np.testing.assert_allclose(
+        direct.score(req)[0].logprobs, restored.score(req)[0].logprobs,
+        atol=1e-5,
+    )
