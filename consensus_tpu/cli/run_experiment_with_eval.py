@@ -73,7 +73,12 @@ def run_pipeline(
             # gpt-4.1 aliasing lives in OpenAIBackend (reference
             # src/evaluation.py:447-462).
             judge_options.setdefault("model", llm_judge_model)
-        if config.get("judge_backend"):
+        if config.get("judge_backend") == "resident":
+            # The generation backend judges with its own resident model —
+            # no second model load — while still counting as a CONFIGURED
+            # judge (per-agent judge scores activate in Phase 2b).
+            judge = backend
+        elif config.get("judge_backend"):
             judge = get_backend(config["judge_backend"], **judge_options)
         else:
             if llm_judge_model:

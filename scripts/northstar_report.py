@@ -38,7 +38,11 @@ API_BASELINE_S_PER_STATEMENT = {
 }
 
 
-def main(log_path: str, results_root: str = "results/aamas") -> int:
+def main(
+    log_path: str,
+    results_root: str = "results/aamas",
+    out_prefix: str = "northstar",
+) -> int:
     text = pathlib.Path(log_path).read_text()
     configs = {m.group(1): m.group(3) for m in CONFIG_RE.finditer(text)}
     rows = []
@@ -148,7 +152,14 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
     }
     mfu_model = model_names.pop() if len(model_names) == 1 else None
     if mfu_model:
-        n_params = param_count(get_model_config(mfu_model))
+        # Random-weight sweeps (all current sweeps) execute a model whose
+        # vocab the backend shrank to the byte tokenizer's id range
+        # (backends/tpu.py checkpoint-is-None branch) — count the params
+        # that actually ran, not the 256k-vocab preset.
+        from consensus_tpu.models.tokenizer import get_tokenizer
+
+        vocab = get_tokenizer(None).vocab_size
+        n_params = param_count(get_model_config(mfu_model, vocab_size=vocab))
         sweep_tflops = useful_tflops_per_sec(n_params, total_tokens, total_wall)
         sweep_pct_peak = pct_of_peak(sweep_tflops)
     else:
@@ -173,7 +184,7 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
     }
     out = pathlib.Path("reports")
     out.mkdir(exist_ok=True)
-    (out / "northstar_timing.json").write_text(json.dumps(report, indent=2))
+    (out / f"{out_prefix}_timing.json").write_text(json.dumps(report, indent=2))
 
     lines = [
         "# North-star timed sweep",
@@ -183,12 +194,13 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
         f"- Weights: {report['weights']}",
         f"- Backend: {backend_options or 'n/a'}",
         (
-            f"- Utilization ({mfu_model}): {total_tokens:,} useful tokens "
+            f"- Utilization ({mfu_model}, random-weight vocab "
+            f"{vocab if mfu_model else 0}): {total_tokens:,} useful tokens "
             f"(generated+scored) -> **{sweep_tflops:.1f} TFLOP/s = "
             f"{sweep_pct_peak:.1f}% of v5e bf16 peak** at 2*params*token; "
             "padding, KV/weight HBM traffic, evaluation/aggregation host "
             "time, and tunnel RTTs all count as lost utilization here "
-            "(scoring kernels alone run at 50-80% MFU warm — "
+            "(scoring kernels alone run at 50-65% MFU warm — "
             "scripts/scoring_bench.py)."
             if mfu_model
             else f"- Utilization: n/a (mixed/unknown models); "
@@ -282,7 +294,7 @@ def main(log_path: str, results_root: str = "results/aamas") -> int:
             f"| {statements} | {breakdown} | {cell:.2f} "
             f"{tok_cols}| {base_cell} | {speedup} |"
         )
-    (out / "northstar_timing.md").write_text("\n".join(lines) + "\n")
+    (out / f"{out_prefix}_timing.md").write_text("\n".join(lines) + "\n")
     print(json.dumps({k: report[k] for k in (
         "configs_completed", "total_wall_s", "total_statements", "under_one_hour"
     )}))

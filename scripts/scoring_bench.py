@@ -66,7 +66,11 @@ def main() -> None:
 
     config = dataclasses.replace(config, use_flash_attention=True)
     n_params = param_count(config)
+    # 2*n_params includes the head matmul ONCE (utils/mfu.py convention) —
+    # split it out so the forward-only arms (no head sweep) are credited
+    # only the body FLOPs and the streamed arms don't double-count it.
     head_flops_per_slot = 2 * config.d_model * config.vocab_size
+    body_flops_per_slot = 2 * n_params - head_flops_per_slot
 
     key = jax.random.PRNGKey(1)
 
@@ -74,8 +78,8 @@ def main() -> None:
         tokens = jax.random.randint(key, (batch, width), 1, 255, jnp.int32)
         valid = jnp.ones((batch, width), bool)
         slots = batch * width
-        fwd = 2 * n_params * slots
-        tot = fwd + head_flops_per_slot * slots
+        fwd = body_flops_per_slot * slots
+        tot = 2 * n_params * slots
         bench(
             f"classic streamed B={batch} S={width}",
             lambda: token_logprobs_streamed(params, config, tokens, valid),
@@ -97,7 +101,7 @@ def main() -> None:
         cont = jax.random.randint(key, (p, l), 1, 255, jnp.int32)
         cont_valid = jnp.ones((p, l), bool)
         slots = p * l
-        fwd = 2 * n_params * (slots + ctx)
+        fwd = body_flops_per_slot * (slots + ctx)
         tot = fwd + head_flops_per_slot * slots
         bench(
             f"shared-context P={p} L={l} ctx={ctx}",

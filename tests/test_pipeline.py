@@ -147,6 +147,28 @@ class TestEvaluator:
         assert len(reasoning) == len(OPINIONS)
         assert matrix["methods"] == list(statements)
 
+    def test_resident_judge_backend(self, tmp_path):
+        """``judge_backend: resident`` judges with the generation backend
+        itself (no second model) AND activates the per-agent judge scores
+        in Phase 2b plus the comparative-ranking artifacts."""
+        import yaml
+
+        from consensus_tpu.cli.run_experiment_with_eval import run_pipeline
+
+        config = base_config(tmp_path, judge_backend="resident", num_seeds=1)
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(yaml.safe_dump(config))
+        run_dir = pd.io.common.os.fspath(run_pipeline(str(cfg_path)))
+        import pathlib
+
+        run_dir = pathlib.Path(run_dir)
+        assert (run_dir / "evaluation/llm_judge/seed_0/ranking_results.csv").exists()
+        eval_csv = pd.read_csv(
+            run_dir / "evaluation/fake-lm/seed_0/evaluation_results.csv"
+        )
+        judge_cols = [c for c in eval_csv.columns if c.startswith("judge_score_")]
+        assert judge_cols, eval_csv.columns.tolist()
+
     def test_ranking_reconstruction_fallback(self):
         """A judge emitting only the raw ``ranking`` array (no method map)
         still yields full rank columns — the reference's reconstruction
