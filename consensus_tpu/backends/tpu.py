@@ -73,6 +73,12 @@ _SHARED_SCORE_ATTN_BYTES_CAP = 1 << 31  # 2 GB
 #: isn't worth its own (1-row prefill + B-tail decode) program variant.
 _SHARED_TRUNK_MIN_ROWS = 4
 
+#: A small identical-prompt group inside a LARGER batch routes classic
+#: instead: combined classic chunks amortize the per-step weight read over
+#: every row in the chunk, which beats the shared path's 1-row prefill
+#: once the group is this small (see _generate_impl docstring).
+_SHARED_TRUNK_SOLO_ROWS = 16
+
 #: Search-session KV caches above this (plus resident weights) risk HBM
 #: exhaustion — fall back to the cacheless full-prefix session instead.
 _SESSION_CACHE_BYTES_CAP = 8 * 1024**3
@@ -632,10 +638,23 @@ class TPUBackend:
         requests: Sequence[GenerationRequest],
         token_lists: Optional[List[List[int]]] = None,
     ) -> List[GenerationResult]:
-        """Route: groups of >=_SHARED_TRUNK_MIN_ROWS identical prompts take
-        the shared-trunk decode (prefill once, per-step KV reads drop from
-        B·(ctx+t) to ctx+B·t — the shape of best_of_n's N drafts and every
-        habermas phase); everything else takes the classic per-row path."""
+        """Route: LARGE groups of identical prompts take the shared-trunk
+        decode (prefill once, per-step KV reads drop from B·(ctx+t) to
+        ctx+B·t — the shape of best_of_n's N drafts and the habermas
+        candidate phase); everything else takes the classic per-row path.
+
+        The size threshold matters because long decodes are weight-read
+        bound: a B-row shared decode pays the full ~5 ms/step weight read
+        over only B rows, while small groups COMBINED into one classic
+        batch amortize it over the whole chunk (measured 0.35-0.41
+        ms/row·step at B=32-48 classic vs ~1.4 ms/row·step at B=4 shared).
+        The habermas revision phase is the canonical case: 30 concurrent
+        statements × min(nc,4) rows of 30 DISTINCT prompts — as 4-row
+        shared groups that is 30 serial small decodes; as classic chunks
+        it is ~4 warm 32-row batches (round-4 fix).  A group that IS the
+        whole batch still takes the shared path at >=_SHARED_TRUNK_MIN_ROWS
+        (nothing else to amortize weights with, and the 1-row prefill
+        wins)."""
         if not requests:
             return []
 
@@ -650,7 +669,12 @@ class TPUBackend:
                 groups.setdefault(tuple(ids), []).append(i)
 
             def takes_shared_path(ids_t, idxs) -> bool:
-                return len(idxs) >= _SHARED_TRUNK_MIN_ROWS and bool(ids_t)
+                if not ids_t or len(idxs) < _SHARED_TRUNK_MIN_ROWS:
+                    return False
+                return (
+                    len(idxs) >= _SHARED_TRUNK_SOLO_ROWS
+                    or len(idxs) == len(requests)
+                )
 
             if any(takes_shared_path(t, i) for t, i in groups.items()):
                 results: List[Optional[GenerationResult]] = [None] * len(requests)

@@ -77,6 +77,13 @@ def run_pipeline(
             # The generation backend judges with its own resident model —
             # no second model load — while still counting as a CONFIGURED
             # judge (per-agent judge scores activate in Phase 2b).
+            if judge_options:
+                logger.warning(
+                    "judge_backend: resident ignores judge_backend_options/"
+                    "--llm-judge-model (%s): the generation backend judges "
+                    "with its own model",
+                    judge_options,
+                )
             judge = backend
         elif config.get("judge_backend"):
             judge = get_backend(config["judge_backend"], **judge_options)

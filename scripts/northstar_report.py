@@ -152,13 +152,21 @@ def main(
     }
     mfu_model = model_names.pop() if len(model_names) == 1 else None
     if mfu_model:
-        # Random-weight sweeps (all current sweeps) execute a model whose
-        # vocab the backend shrank to the byte tokenizer's id range
-        # (backends/tpu.py checkpoint-is-None branch) — count the params
-        # that actually ran, not the 256k-vocab preset.
-        from consensus_tpu.models.tokenizer import get_tokenizer
+        # Random-weight sweeps execute a model whose vocab the backend
+        # shrank to the byte tokenizer's id range (backends/tpu.py
+        # checkpoint-is-None branch) — count the params that actually ran.
+        # A checkpoint/tokenizer-configured sweep keeps the preset vocab.
+        random_weights = not any(
+            isinstance(opts, dict)
+            and (opts.get("checkpoint") or opts.get("tokenizer"))
+            for opts in (seen_options or [])
+        )
+        if random_weights:
+            from consensus_tpu.models.tokenizer import get_tokenizer
 
-        vocab = get_tokenizer(None).vocab_size
+            vocab = get_tokenizer(None).vocab_size
+        else:
+            vocab = get_model_config(mfu_model).vocab_size
         n_params = param_count(get_model_config(mfu_model, vocab_size=vocab))
         sweep_tflops = useful_tflops_per_sec(n_params, total_tokens, total_wall)
         sweep_pct_peak = pct_of_peak(sweep_tflops)

@@ -155,13 +155,12 @@ class TestEvaluator:
 
         from consensus_tpu.cli.run_experiment_with_eval import run_pipeline
 
+        import pathlib
+
         config = base_config(tmp_path, judge_backend="resident", num_seeds=1)
         cfg_path = tmp_path / "cfg.yaml"
         cfg_path.write_text(yaml.safe_dump(config))
-        run_dir = pd.io.common.os.fspath(run_pipeline(str(cfg_path)))
-        import pathlib
-
-        run_dir = pathlib.Path(run_dir)
+        run_dir = pathlib.Path(run_pipeline(str(cfg_path)))
         assert (run_dir / "evaluation/llm_judge/seed_0/ranking_results.csv").exists()
         eval_csv = pd.read_csv(
             run_dir / "evaluation/fake-lm/seed_0/evaluation_results.csv"

@@ -113,3 +113,63 @@ def test_shared_trunk_with_bias_tables(shared, classic):
     ours = shared.generate(requests)
     ref = classic.generate(requests)
     assert [r.token_ids for r in ours] == [r.token_ids for r in ref]
+
+
+class TestRoutingThreshold:
+    """Small identical-prompt groups inside a larger batch route CLASSIC
+    (combined chunks amortize the per-step weight read); big groups and
+    whole-batch groups still take the shared path (round-4 routing fix —
+    the habermas revision phase is 30 distinct 4-row groups)."""
+
+    def _routes(self, backend, requests, monkeypatch):
+        import consensus_tpu.backends.tpu as tpu_mod
+
+        calls = {"shared": 0, "classic": 0}
+        orig_shared = tpu_mod.TPUBackend._generate_shared
+        orig_classic = tpu_mod.TPUBackend._generate_classic
+
+        def spy_shared(self, reqs, ids):
+            calls["shared"] += 1
+            return orig_shared(self, reqs, ids)
+
+        def spy_classic(self, reqs, ids):
+            calls["classic"] += 1
+            return orig_classic(self, reqs, ids)
+
+        monkeypatch.setattr(tpu_mod.TPUBackend, "_generate_shared", spy_shared)
+        monkeypatch.setattr(tpu_mod.TPUBackend, "_generate_classic", spy_classic)
+        results = backend.generate(requests)
+        assert all(r.ok for r in results)
+        return calls
+
+    def test_small_groups_in_big_batch_go_classic(self, shared, monkeypatch):
+        requests = [
+            GenerationRequest(
+                user_prompt=f"Revision prompt {g}", max_tokens=8, seed=g * 10 + i
+            )
+            for g in range(5)
+            for i in range(4)  # 5 distinct 4-row groups
+        ]
+        calls = self._routes(shared, requests, monkeypatch)
+        assert calls["shared"] == 0 and calls["classic"] >= 1
+
+    def test_whole_batch_group_stays_shared(self, shared, monkeypatch):
+        requests = [
+            GenerationRequest(user_prompt="One prompt", max_tokens=8, seed=i)
+            for i in range(4)
+        ]
+        calls = self._routes(shared, requests, monkeypatch)
+        assert calls["shared"] == 1 and calls["classic"] == 0
+
+    def test_large_group_in_mixed_batch_stays_shared(self, shared, monkeypatch):
+        from consensus_tpu.backends.tpu import _SHARED_TRUNK_SOLO_ROWS
+
+        requests = [
+            GenerationRequest(user_prompt="Big group", max_tokens=8, seed=i)
+            for i in range(_SHARED_TRUNK_SOLO_ROWS)
+        ] + [
+            GenerationRequest(user_prompt=f"Stray {i}", max_tokens=8, seed=99 + i)
+            for i in range(2)
+        ]
+        calls = self._routes(shared, requests, monkeypatch)
+        assert calls["shared"] == 1 and calls["classic"] >= 1
