@@ -66,12 +66,19 @@ class BatchingBackend:
         self._cond = threading.Condition()
         self._active = 0
         self._started = 0
+        self._flushing = False
         self._queues: Dict[str, List[_Pending]] = {
             "generate": [], "score": [], "next_token": [], "embed": [],
         }
         #: Device batches actually issued per kind — the measurable win:
         #: N concurrent runs << N× the solo batch count.
         self.batch_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+
+    @property
+    def deterministic_greedy(self) -> bool:
+        """Merging requests into shared batches never changes per-request
+        results (per-row PRNG keys), so determinism is the inner backend's."""
+        return bool(getattr(self.inner, "deterministic_greedy", False))
 
     def open_fused_token_search(self, spec):
         """Fused token-search sessions bypass the request queue: each session
@@ -127,6 +134,28 @@ class BatchingBackend:
 
     # -- core --------------------------------------------------------------
 
+    def _window_s(self, kind: str) -> float:
+        """Quiescence window before a timeout flush.
+
+        A flat 10 ms window fragments phase transitions: decode steps are
+        weights-bound, so a 4-row 700-token generate costs nearly the same
+        multi-second wall as a 48-row one, yet the first run to reach a new
+        phase used to flush its rows solo while its 29 siblings were still
+        parsing the previous phase host-side.  Patience worth ~5% of the
+        queued batch's expected decode wall (~8 ms/step) is host-side noise
+        next to the dispatch it saves; cheap calls keep the fast window, and
+        the all-blocked fast path still flushes singleton sessions
+        immediately."""
+        if kind != "generate":
+            return self.flush_s
+        queued = self._queues["generate"]
+        if not queued:
+            return self.flush_s
+        longest = max(r.max_tokens for e in queued for r in e.requests)
+        # Cap only the scaled term: a configured flush_s above the cap is an
+        # operator choice that generate must honor like every other kind.
+        return max(self.flush_s, min(0.5, 0.05 * 0.008 * longest))
+
     def _call(self, kind: str, requests: List[Any], fn: Callable) -> Any:
         if not requests:
             return fn(requests)
@@ -135,32 +164,67 @@ class BatchingBackend:
             self._queues[kind].append(entry)
             self._cond.notify_all()
             while not entry.done:
+                if self._flushing:
+                    # A device batch is executing with the lock released:
+                    # this entry rides the NEXT flush, merged with everything
+                    # else that arrives during the multi-second device call.
+                    self._cond.wait(timeout=self.flush_s)
+                    continue
                 pending = sum(len(q) for q in self._queues.values())
                 ramped = self._started >= self.expected_sessions
                 if ramped and pending >= max(self._active, 1):
-                    # Every active session is blocked on a call: flush now.
-                    self._flush_locked()
-                elif not self._cond.wait(timeout=self.flush_s):
-                    # Timeout: some session is busy host-side; don't stall.
-                    self._flush_locked()
+                    # Every active session is blocked on a call: flush
+                    # EVERYTHING — nobody is coming to widen any batch.
+                    self._flush(tuple(self._queues))
+                elif not self._cond.wait(timeout=self._window_s(kind)):
+                    # Quiescent for a full window (appends notify): flush
+                    # THIS kind only — other kinds run their own windows
+                    # (a 10 ms score timeout must not fragment a generate
+                    # batch sitting out its longer patience window).  The
+                    # wait released the lock, so another thread may have
+                    # started a flush meanwhile: re-check before claiming.
+                    if not self._flushing and not entry.done:
+                        self._flush((kind,))
         if entry.error is not None:
             raise entry.error
         return entry.result
 
-    def _flush_locked(self) -> None:
-        """Execute all queued batches.  Called with the lock held; the inner
-        call runs under the lock — other sessions are blocked waiting for
-        results anyway, and single-threading device access is required."""
+    def _flush(self, kinds: Sequence[str]) -> None:
+        """Snapshot the given kinds' queues and execute them with the lock
+        RELEASED.
+
+        Called with the lock held and ``_flushing`` False.  Releasing during
+        the inner calls lets other sessions enqueue while the device is busy
+        — their requests accumulate into one merged batch dispatched the
+        moment this flush returns, which is what keeps phase-drifted sweep
+        cells riding full-width device batches.  ``_flushing`` keeps the
+        flush single-file (one chip; results must map back to their
+        waiters)."""
+        self._flushing = True
+        snapshot = {k: [] for k in self._queues}
+        for k in kinds:
+            snapshot[k] = self._queues[k]
+            self._queues[k] = []
+        self._cond.release()
+        try:
+            self._run_batches(snapshot)
+        finally:
+            self._cond.acquire()
+            self._flushing = False
+            self._cond.notify_all()
+
+    def _run_batches(self, snapshot: Dict[str, List[_Pending]]) -> None:
+        """Dispatch each kind's merged batch; no lock held (waiters re-check
+        ``entry.done`` under the lock after the flush-end notify)."""
         for kind, fn in (
             ("generate", self.inner.generate),
             ("score", self.inner.score),
             ("next_token", self.inner.next_token_logprobs),
             ("embed", self.inner.embed),
         ):
-            queue = self._queues[kind]
+            queue = snapshot[kind]
             if not queue:
                 continue
-            self._queues[kind] = []
             merged: List[Any] = []
             for entry in queue:
                 merged.extend(entry.requests)
@@ -180,4 +244,3 @@ class BatchingBackend:
                 for entry in queue:
                     entry.error = exc
                     entry.done = True
-        self._cond.notify_all()

@@ -79,6 +79,11 @@ class FakeBackend:
     """Deterministic pseudo-LM implementing the :class:`Backend` protocol."""
 
     name = "fake"
+    #: The fake LM keys every response on (prompt, seed) and IGNORES
+    #: temperature, so a temperature-0 retry with a new seed genuinely
+    #: differs here — unlike TPUBackend's argmax path.  Keep False so the
+    #: fake pipeline exercises the reference's full retry choreography.
+    deterministic_greedy = False
 
     def __init__(self, embed_dim: int = 64, instruction_following: bool = True):
         self.embed_dim = embed_dim

@@ -136,6 +136,12 @@ def _width_bucket(n: int, minimum: int = 128) -> int:
 
 class TPUBackend:
     name = "tpu"
+    #: Temperature-0 generation is argmax (models/sampling.py): the request
+    #: seed never enters the program, so re-issuing an identical greedy
+    #: request is bitwise-identical.  Callers with seed-incrementing retry
+    #: loops (habermas rankings) use this to elide provably-identical
+    #: retries.  API backends stay False (server-side nondeterminism).
+    deterministic_greedy = True
 
     def __init__(
         self,

@@ -324,6 +324,15 @@ class HabermasMachineGenerator(BaseGenerator):
                 else:
                     still.append(i)
             pending = still
+            # Rankings decode at temperature 0 (reference :948).  The
+            # reference retries failures with incremented seeds
+            # (habermas_machine.py:939-982), but on a backend whose greedy
+            # decode is argmax the seed never enters the program — a retry
+            # would replay the identical response and fail the identical
+            # parse.  Elide those provably-no-op retries; nondeterministic
+            # backends (API, fake) keep the full retry choreography.
+            if getattr(self.backend, "deterministic_greedy", False):
+                break
         if pending and self._timing_fallbacks:
             for i in pending:
                 rankings[agents[i][0]] = np.arange(len(statements))

@@ -80,7 +80,17 @@ def main() -> None:
     with open(CONFIG) as f:
         config = yaml.safe_load(f)
 
-    backend = get_backend(config.get("backend"), **(config.get("backend_options") or {}))
+    if os.environ.get("PROFILE_PIN"):
+        # Mirror run_sweep --timing-pin-budget in full: the method-side
+        # pin_budget half is injected by Experiment._run_configs from this
+        # flag, and the backend-side pin_generation_budget half (device
+        # EOS early-exit disabled) is applied to the explicit backend below.
+        config["timing_pin_budget"] = True
+
+    backend_opts = dict(config.get("backend_options") or {})
+    if config.get("timing_pin_budget") and config.get("backend") == "tpu":
+        backend_opts["pin_generation_budget"] = True
+    backend = get_backend(config.get("backend"), **backend_opts)
 
     # Instrument the inner generate (what each Batching flush calls).
     inner_calls = []

@@ -210,3 +210,77 @@ class TestExperimentConcurrency:
         conc_total = sum(conc_backend.batches.values())
         assert conc_total < seq_total
         assert experiment.last_batch_counts == conc_backend.batches
+
+
+class TestFlushSingleFile:
+    def test_no_concurrent_inner_calls_and_arrivals_merge(self):
+        """The flush runs with the lock RELEASED so arrivals can enqueue
+        during a device call — but inner-backend dispatches must stay
+        single-file, and requests arriving mid-flush must merge into the
+        NEXT batch rather than fragmenting into solo dispatches."""
+        import time
+
+        class SlowInner:
+            name = "slow"
+
+            def __init__(self):
+                self.inner = FakeBackend()
+                self.calls = []          # row counts per dispatch
+                self._in_call = False
+                self.overlapped = False
+
+            def generate(self, requests):
+                if self._in_call:
+                    self.overlapped = True
+                self._in_call = True
+                try:
+                    time.sleep(0.15)      # a "device" call much longer than
+                    return self.inner.generate(requests)  # any flush window
+                finally:
+                    self.calls.append(len(requests))
+                    self._in_call = False
+
+            def score(self, requests):
+                return self.inner.score(requests)
+
+            def next_token_logprobs(self, requests):
+                return self.inner.next_token_logprobs(requests)
+
+            def embed(self, texts):
+                return self.inner.embed(texts)
+
+        inner = SlowInner()
+        batching = BatchingBackend(inner, flush_ms=5.0, expected_sessions=6)
+        done = []
+
+        def leader():
+            with batching.session():
+                done.append(
+                    batching.generate(
+                        [GenerationRequest(user_prompt="lead", max_tokens=4, seed=0)]
+                    )
+                )
+
+        def follower(i):
+            with batching.session():
+                time.sleep(0.05 + 0.01 * i)  # arrive while leader's flush runs
+                done.append(
+                    batching.generate(
+                        [GenerationRequest(user_prompt=f"f{i}", max_tokens=4, seed=i)]
+                    )
+                )
+
+        threads = [threading.Thread(target=leader)] + [
+            threading.Thread(target=follower, args=(i,)) for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not inner.overlapped, "two flushes ran concurrently"
+        assert len(done) == 6
+        # The 5 followers all arrived during the leader's 150 ms device call
+        # (≥60 ms of margin) and must ride ONE follow-up batch — 3 dispatches
+        # would mean the timeout path re-fragmented a mid-flush arrival.
+        assert len(inner.calls) <= 2
+        assert sum(inner.calls) == 6

@@ -134,3 +134,40 @@ def test_timing_fallbacks_run_full_pipeline():
     assert all(r is not None for r in generator.agent_rankings.values())
     assert generator.all_round_data
     assert generator.all_round_data[0].get("revised_statements")
+
+
+class _CountingWrapper:
+    """Delegating backend wrapper that records temperature-0 generate rows."""
+
+    def __init__(self, inner, deterministic):
+        self._inner = inner
+        self.deterministic_greedy = deterministic
+        self.greedy_rows = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def generate(self, requests):
+        self.greedy_rows += sum(1 for r in requests if r.temperature == 0.0)
+        return self._inner.generate(requests)
+
+
+@pytest.mark.parametrize("deterministic,expected_attempts", [(True, 1), (False, 3)])
+def test_greedy_ranking_retries_elided_on_deterministic_backends(
+    monkeypatch, deterministic, expected_attempts
+):
+    """Rankings decode at temperature 0; on a backend whose greedy path is
+    argmax (seed never enters the program) a seed-incremented retry replays
+    the identical response, so habermas elides it.  Nondeterministic
+    backends keep the reference's full retry choreography."""
+    import consensus_tpu.methods.habermas as habermas_mod
+
+    monkeypatch.setattr(
+        habermas_mod, "process_ranking_response", lambda *a, **k: (None, None)
+    )
+    backend = _CountingWrapper(FakeBackend(), deterministic)
+    gen = make_gen(backend, num_retries_on_error=2)
+    gen.generate_statement(ISSUE, OPINIONS)
+    # All rankings fail to parse -> winner is None -> only the round-0
+    # ranking phase runs: one temp-0 request per agent per attempt.
+    assert backend.greedy_rows == expected_attempts * len(OPINIONS)
