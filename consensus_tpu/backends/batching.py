@@ -168,7 +168,10 @@ class BatchingBackend:
                     # A device batch is executing with the lock released:
                     # this entry rides the NEXT flush, merged with everything
                     # else that arrives during the multi-second device call.
-                    self._cond.wait(timeout=self.flush_s)
+                    # Untimed: flush end always notify_all()s under the lock
+                    # (including on abort — _flush's finally errors stranded
+                    # entries), so polling here would only burn host cycles.
+                    self._cond.wait()
                     continue
                 pending = sum(len(q) for q in self._queues.values())
                 ramped = self._started >= self.expected_sessions
@@ -201,16 +204,35 @@ class BatchingBackend:
         flush single-file (one chip; results must map back to their
         waiters)."""
         self._flushing = True
-        snapshot = {k: [] for k in self._queues}
-        for k in kinds:
-            snapshot[k] = self._queues[k]
-            self._queues[k] = []
-        self._cond.release()
+        snapshot: Dict[str, List[_Pending]] = {k: [] for k in self._queues}
+        released = False
         try:
+            for k in kinds:
+                snapshot[k] = self._queues[k]
+                self._queues[k] = []
+            self._cond.release()
+            released = True
             self._run_batches(snapshot)
         finally:
-            self._cond.acquire()
+            # Guard the WHOLE flush, not just _run_batches: an abort during
+            # the snapshot/release lines must still clear _flushing (waiters
+            # park in an untimed wait) and fail stranded entries.
+            if released:
+                self._cond.acquire()
             self._flushing = False
+            # A non-Exception abort (KeyboardInterrupt between per-kind
+            # dispatches) can leave snapshotted entries undone AND already
+            # off their queues; without this their waiters would block
+            # forever.  Normal completion marks every entry done, so this
+            # loop is a no-op on the happy path.
+            for queue in snapshot.values():
+                for entry in queue:
+                    if not entry.done:
+                        entry.error = RuntimeError(
+                            "batch flush aborted before this request was "
+                            "dispatched"
+                        )
+                        entry.done = True
             self._cond.notify_all()
 
     def _run_batches(self, snapshot: Dict[str, List[_Pending]]) -> None:
@@ -244,3 +266,9 @@ class BatchingBackend:
                 for entry in queue:
                     entry.error = exc
                     entry.done = True
+            # Wake this kind's waiters NOW rather than at flush end: their
+            # host-side work (parsing, prompt building) overlaps the
+            # remaining kinds' device dispatches — mid-flush waiters park in
+            # an untimed wait and would otherwise sleep out the whole flush.
+            with self._cond:
+                self._cond.notify_all()

@@ -331,6 +331,18 @@ class HabermasMachineGenerator(BaseGenerator):
             # would replay the identical response and fail the identical
             # parse.  Elide those provably-no-op retries; nondeterministic
             # backends (API, fake) keep the full retry choreography.
+            #
+            # PREMISE (ADVICE r4): the elided retry would run in a different
+            # batch composition (fewer pending rows, possibly another padding
+            # bucket) than attempt 0, so "identical replay" additionally
+            # assumes greedy argmax is invariant to batch width on the real
+            # device.  XLA does not promise cross-shape accumulation-order
+            # stability in general; validate the premise on the target
+            # device with scripts/greedy_batch_invariance_check.py (same
+            # greedy request re-issued at batch widths 1/4/16, asserts
+            # token-identical; writes reports/greedy_batch_invariance.md)
+            # before relying on the elision.  If the check fails for a
+            # model/config, drop this break.
             if getattr(self.backend, "deterministic_greedy", False):
                 break
         if pending and self._timing_fallbacks:

@@ -217,7 +217,12 @@ class TestFlushSingleFile:
         """The flush runs with the lock RELEASED so arrivals can enqueue
         during a device call — but inner-backend dispatches must stay
         single-file, and requests arriving mid-flush must merge into the
-        NEXT batch rather than fragmenting into solo dispatches."""
+        NEXT batch rather than fragmenting into solo dispatches.
+
+        Follower arrival is gated on an event set inside the inner
+        ``generate`` (and the first dispatch holds until all followers have
+        enqueued), so arrival-mid-flush is guaranteed rather than raced
+        against a fixed sleep (ADVICE r4)."""
         import time
 
         class SlowInner:
@@ -228,14 +233,27 @@ class TestFlushSingleFile:
                 self.calls = []          # row counts per dispatch
                 self._in_call = False
                 self.overlapped = False
+                self.batching = None      # wired up after wrapper construction
+                self.first_dispatch = threading.Event()
 
             def generate(self, requests):
                 if self._in_call:
                     self.overlapped = True
                 self._in_call = True
                 try:
-                    time.sleep(0.15)      # a "device" call much longer than
-                    return self.inner.generate(requests)  # any flush window
+                    if not self.first_dispatch.is_set():
+                        self.first_dispatch.set()
+                        # Hold the first "device call" open until every
+                        # follower has enqueued — guaranteed mid-flush
+                        # arrival, bounded so a broken follower can't hang.
+                        deadline = time.monotonic() + 10.0
+                        while time.monotonic() < deadline:
+                            with self.batching._cond:
+                                if len(self.batching._queues["generate"]) >= 5:
+                                    break
+                            time.sleep(0.005)
+                    time.sleep(0.01)      # device-call stand-in
+                    return self.inner.generate(requests)
                 finally:
                     self.calls.append(len(requests))
                     self._in_call = False
@@ -251,6 +269,7 @@ class TestFlushSingleFile:
 
         inner = SlowInner()
         batching = BatchingBackend(inner, flush_ms=5.0, expected_sessions=6)
+        inner.batching = batching
         done = []
 
         def leader():
@@ -263,7 +282,9 @@ class TestFlushSingleFile:
 
         def follower(i):
             with batching.session():
-                time.sleep(0.05 + 0.01 * i)  # arrive while leader's flush runs
+                # Enqueue only once the leader's dispatch has started; the
+                # inner call then waits for all 5 of us before returning.
+                assert inner.first_dispatch.wait(timeout=10.0)
                 done.append(
                     batching.generate(
                         [GenerationRequest(user_prompt=f"f{i}", max_tokens=4, seed=i)]
@@ -279,8 +300,76 @@ class TestFlushSingleFile:
             t.join()
         assert not inner.overlapped, "two flushes ran concurrently"
         assert len(done) == 6
-        # The 5 followers all arrived during the leader's 150 ms device call
-        # (≥60 ms of margin) and must ride ONE follow-up batch — 3 dispatches
-        # would mean the timeout path re-fragmented a mid-flush arrival.
+        # The 5 followers all arrived during the leader's device call (the
+        # inner generate held until their entries were queued) and must ride
+        # ONE follow-up batch — 3 dispatches would mean the timeout path
+        # re-fragmented a mid-flush arrival.
         assert len(inner.calls) <= 2
         assert sum(inner.calls) == 6
+
+
+class TestAbortedFlushFailsWaiters:
+    def test_base_exception_mid_flush_errors_stranded_entries(self):
+        """A non-Exception abort between per-kind dispatches (e.g.
+        KeyboardInterrupt) must not strand waiters whose kind never ran:
+        their snapshot entries are off the queues, so _flush's finally has
+        to error them or the waiter threads block forever (ADVICE r4)."""
+
+        class AbortingInner:
+            name = "aborting"
+
+            def __init__(self):
+                self.inner = FakeBackend()
+
+            def generate(self, requests):
+                # Abort mid-flush with a BaseException: "score" entries in
+                # the same snapshot never get dispatched.
+                raise KeyboardInterrupt
+
+            def score(self, requests):
+                return self.inner.score(requests)
+
+            def next_token_logprobs(self, requests):
+                return self.inner.next_token_logprobs(requests)
+
+            def embed(self, texts):
+                return self.inner.embed(texts)
+
+        # Huge window: the scorer must NOT timeout-flush its entry solo —
+        # only the all-blocked path (triggered by the generate below) may
+        # flush, so both kinds land in one snapshot.
+        batching = BatchingBackend(
+            AbortingInner(), flush_ms=30_000.0, expected_sessions=2
+        )
+        score_outcome = {}
+
+        def scorer():
+            with batching.session():
+                try:
+                    batching.score(
+                        [ScoreRequest(context="ctx", continuation=" more")]
+                    )
+                    score_outcome["result"] = "ok"
+                except RuntimeError as exc:
+                    score_outcome["result"] = str(exc)
+
+        scorer_thread = threading.Thread(target=scorer)
+        with batching.session():
+            scorer_thread.start()
+            # Wait for the scorer's entry to be queued so the all-blocked
+            # flush snapshots BOTH kinds, then trigger it via generate.
+            import time
+
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                with batching._cond:
+                    if batching._queues["score"]:
+                        break
+                time.sleep(0.005)
+            with pytest.raises(KeyboardInterrupt):
+                batching.generate(
+                    [GenerationRequest(user_prompt="g", max_tokens=4, seed=0)]
+                )
+        scorer_thread.join(timeout=10.0)
+        assert not scorer_thread.is_alive(), "score waiter was stranded"
+        assert "aborted" in score_outcome.get("result", "")
