@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import statistics
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -45,6 +46,10 @@ logging.disable(logging.WARNING)  # keep the single-JSON-line contract
 N_CANDIDATES = int(os.environ.get("BENCH_N", "32"))
 NEW_TOKENS = int(os.environ.get("BENCH_TOKENS", "50"))
 N_CONCURRENT = int(os.environ.get("BENCH_CONCURRENT", "8"))  # throughput regime
+#: Headline trials: single-trial numbers on a tunneled chip showed 17-21%
+#: run-to-run spread across rounds (VERDICT r4 weak #3) — report the median
+#: of >=3 trials with min/max so regression and noise are distinguishable.
+N_TRIALS = max(1, int(os.environ.get("BENCH_TRIALS", "3")))
 BON_LATENCY_ROUNDS = 2
 BASELINE_BON_STATEMENTS_PER_SEC = 1.0 / 70.0
 BASELINE_BEAM_STATEMENTS_PER_SEC = 1.0 / 4019.0
@@ -107,10 +112,14 @@ def main() -> None:
         return elapsed
 
     bon_cobatched(7000)  # warmup / compile (wide co-batched shapes)
-    tokens_before = dict(backend.token_counts)  # after warmup: timed run only
-    throughput_wall = bon_cobatched(100)
-    throughput_sps = N_CONCURRENT / throughput_wall
+    tokens_before = dict(backend.token_counts)  # after warmup: timed runs only
+    trial_walls = [bon_cobatched(100 + 1000 * t) for t in range(N_TRIALS)]
     tokens_after = dict(backend.token_counts)
+    throughput_wall = statistics.median(trial_walls)
+    throughput_sps = N_CONCURRENT / throughput_wall
+    # min wall = max st/s and vice versa: spread bounds for the headline.
+    throughput_sps_max = N_CONCURRENT / min(trial_walls)
+    throughput_sps_min = N_CONCURRENT / max(trial_walls)
 
     # ---- latency regime: one statement at a time ---------------------
     one_bon(7, backend)  # warmup (narrow single-cell shapes)
@@ -169,7 +178,7 @@ def main() -> None:
     n_params = param_count(backend.config)
     bench_total_tokens = sum(bench_tokens.values())
     throughput_tflops = useful_tflops_per_sec(
-        n_params, bench_total_tokens, throughput_wall
+        n_params, bench_total_tokens, sum(trial_walls)
     )
     print(
         json.dumps(
@@ -178,6 +187,7 @@ def main() -> None:
                 "value": round(throughput_sps, 4),
                 "unit": "statements/sec (THROUGHPUT regime: "
                         f"{N_CONCURRENT} co-batched sweep-style statements; "
+                        f"median of {N_TRIALS} trials; "
                         f"real stack, {os.environ.get('BENCH_MODEL', 'gemma2-2b')}, "
                         f"5-agent, N={N_CANDIDATES}, {NEW_TOKENS} tok)",
                 "vs_baseline": round(
@@ -192,7 +202,20 @@ def main() -> None:
                                    "the tunneled chip)",
                     },
                     "bon_throughput_wall_s": round(throughput_wall, 2),
-                    "bon_throughput_tokens": bench_tokens,
+                    "bon_throughput_trial_walls_s": [
+                        round(w, 2) for w in trial_walls
+                    ],
+                    "bon_throughput_walls_sum_s": round(sum(trial_walls), 2),
+                    "bon_throughput_sps_spread": {
+                        "median": round(throughput_sps, 4),
+                        "min": round(throughput_sps_min, 4),
+                        "max": round(throughput_sps_max, 4),
+                        "n_trials": N_TRIALS,
+                    },
+                    # Renamed from bon_throughput_tokens (r1-r4: ONE timed
+                    # run): now summed over all N_TRIALS timed runs — divide
+                    # by walls_sum_s, not wall_s, for tokens/sec.
+                    "bon_throughput_tokens_all_trials": bench_tokens,
                     "throughput_tflops_per_sec": round(throughput_tflops, 2),
                     "throughput_pct_of_v5e_bf16_peak": round(
                         pct_of_peak(throughput_tflops), 2

@@ -66,7 +66,7 @@ def main() -> None:
     siblings = [
         f"Issue: {scenario['issue']}\n\nOpinion: {opinions[i % len(opinions)]}\n\n"
         f"Sibling prompt variant {i}: write a consensus statement."
-        for i in range(16)
+        for i in range(63)
     ]
 
     def run(width: int, target_pos: int) -> str:
@@ -81,7 +81,13 @@ def main() -> None:
         results = backend.generate(requests)
         return results[target_pos].text
 
-    compositions = [(1, 0), (4, 0), (4, 3), (16, 0), (16, 15)]
+    # Widths must STRADDLE padding-bucket boundaries, not just vary inside
+    # one bucket: tpu.py buckets rows (minimum 8), so widths 1 and 4 would
+    # execute the identical 8-row program.  1/8 share the smallest bucket;
+    # 9 forces the next one; 32/64 are the shapes real sweep batches
+    # (max_batch_rows up to 64) actually run — the compositions an elided
+    # habermas retry would have landed in.
+    compositions = [(1, 0), (8, 0), (9, 8), (32, 0), (32, 31), (64, 63)]
     outputs = {}
     for width, pos in compositions:
         key = f"width={width},pos={pos}"
