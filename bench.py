@@ -111,10 +111,19 @@ def main() -> None:
         assert all(isinstance(s, str) for s in statements)
         return elapsed
 
+    from consensus_tpu.obs import (
+        bucket_recompiles,
+        diff_snapshots,
+        get_registry,
+        padding_efficiency,
+    )
+
     bon_cobatched(7000)  # warmup / compile (wide co-batched shapes)
     tokens_before = dict(backend.token_counts)  # after warmup: timed runs only
+    metrics_before = get_registry().snapshot()
     trial_walls = [bon_cobatched(100 + 1000 * t) for t in range(N_TRIALS)]
     tokens_after = dict(backend.token_counts)
+    metrics_timed = diff_snapshots(metrics_before, get_registry().snapshot())
     throughput_wall = statistics.median(trial_walls)
     throughput_sps = N_CONCURRENT / throughput_wall
     # min wall = max st/s and vice versa: spread bounds for the headline.
@@ -177,6 +186,7 @@ def main() -> None:
 
     n_params = param_count(backend.config)
     bench_total_tokens = sum(bench_tokens.values())
+    padding_eff = padding_efficiency(metrics_timed)
     throughput_tflops = useful_tflops_per_sec(
         n_params, bench_total_tokens, sum(trial_walls)
     )
@@ -216,6 +226,25 @@ def main() -> None:
                     # run): now summed over all N_TRIALS timed runs — divide
                     # by walls_sum_s, not wall_s, for tokens/sec.
                     "bon_throughput_tokens_all_trials": bench_tokens,
+                    # Derived here so r1-r4 vs r5+ token numbers compare
+                    # directly without readers redoing the wall division.
+                    "tokens_per_sec": round(
+                        bench_total_tokens / sum(trial_walls), 1
+                    ),
+                    # obs-derived hardware-efficiency trajectory (timed
+                    # throughput window): useful/allocated tokens across the
+                    # padded device grids, and how many padded program
+                    # shapes compiled.  Steady-state recompiles should be 0
+                    # after warmup; total counts the whole process.
+                    "padding_efficiency": (
+                        round(padding_eff, 4) if padding_eff is not None else None
+                    ),
+                    "bucket_recompiles": bucket_recompiles(
+                        get_registry().snapshot()
+                    ),
+                    "bucket_recompiles_timed_window": bucket_recompiles(
+                        metrics_timed
+                    ),
                     "throughput_tflops_per_sec": round(throughput_tflops, 2),
                     "throughput_pct_of_v5e_bf16_peak": round(
                         pct_of_peak(throughput_tflops), 2

@@ -24,7 +24,8 @@ from __future__ import annotations
 
 import contextlib
 import threading
-from typing import Any, Callable, Dict, List, Sequence
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -37,16 +38,22 @@ from consensus_tpu.backends.base import (
     ScoreResult,
     TokenCandidate,
 )
+from consensus_tpu.obs.metrics import (
+    DEFAULT_COUNT_BUCKETS,
+    Registry,
+    get_registry,
+)
 
 
 class _Pending:
-    __slots__ = ("requests", "result", "error", "done")
+    __slots__ = ("requests", "result", "error", "done", "enqueued")
 
     def __init__(self, requests):
         self.requests = requests
         self.result = None
         self.error = None
         self.done = False
+        self.enqueued = time.perf_counter()
 
 
 class BatchingBackend:
@@ -55,10 +62,41 @@ class BatchingBackend:
     name = "batching"
 
     def __init__(
-        self, inner: Backend, flush_ms: float = 10.0, expected_sessions: int = 1
+        self,
+        inner: Backend,
+        flush_ms: float = 10.0,
+        expected_sessions: int = 1,
+        registry: Optional[Registry] = None,
     ):
         self.inner = inner
         self.flush_s = flush_ms / 1000.0
+        # obs: queue-wait (enqueue -> dispatch), batch-fill (sessions merged
+        # per flush), and flush-reason accounting.  ``registry`` isolates
+        # tests from the process-global registry.
+        reg = registry if registry is not None else get_registry()
+        self._queue_wait = reg.histogram(
+            "batching_queue_wait_seconds",
+            "Time a session's call waited in the merge queue before its "
+            "batch dispatched.",
+            labels=("kind",),
+        )
+        self._batch_fill = reg.histogram(
+            "batching_batch_fill_sessions",
+            "Sessions merged into one device batch per flush.",
+            labels=("kind",),
+            buckets=DEFAULT_COUNT_BUCKETS,
+        )
+        self._merged_requests = reg.counter(
+            "batching_merged_requests_total",
+            "Requests merged into shared device batches.",
+            labels=("kind",),
+        )
+        self._flushes = reg.counter(
+            "batching_flushes_total",
+            "Batch flushes by trigger: all active sessions blocked vs. "
+            "flush_ms quiescence timeout.",
+            labels=("kind", "reason"),
+        )
         #: Until this many sessions have STARTED, the all-blocked heuristic
         #: is suppressed — otherwise the first worker to enqueue during pool
         #: ramp-up sees active==1 and flushes a batch of one.
@@ -178,7 +216,7 @@ class BatchingBackend:
                 if ramped and pending >= max(self._active, 1):
                     # Every active session is blocked on a call: flush
                     # EVERYTHING — nobody is coming to widen any batch.
-                    self._flush(tuple(self._queues))
+                    self._flush(tuple(self._queues), reason="all_blocked")
                 elif not self._cond.wait(timeout=self._window_s(kind)):
                     # Quiescent for a full window (appends notify): flush
                     # THIS kind only — other kinds run their own windows
@@ -187,12 +225,12 @@ class BatchingBackend:
                     # wait released the lock, so another thread may have
                     # started a flush meanwhile: re-check before claiming.
                     if not self._flushing and not entry.done:
-                        self._flush((kind,))
+                        self._flush((kind,), reason="timeout")
         if entry.error is not None:
             raise entry.error
         return entry.result
 
-    def _flush(self, kinds: Sequence[str]) -> None:
+    def _flush(self, kinds: Sequence[str], reason: str = "all_blocked") -> None:
         """Snapshot the given kinds' queues and execute them with the lock
         RELEASED.
 
@@ -212,7 +250,7 @@ class BatchingBackend:
                 self._queues[k] = []
             self._cond.release()
             released = True
-            self._run_batches(snapshot)
+            self._run_batches(snapshot, reason)
         finally:
             # Guard the WHOLE flush, not just _run_batches: an abort during
             # the snapshot/release lines must still clear _flushing (waiters
@@ -235,7 +273,9 @@ class BatchingBackend:
                         entry.done = True
             self._cond.notify_all()
 
-    def _run_batches(self, snapshot: Dict[str, List[_Pending]]) -> None:
+    def _run_batches(
+        self, snapshot: Dict[str, List[_Pending]], reason: str
+    ) -> None:
         """Dispatch each kind's merged batch; no lock held (waiters re-check
         ``entry.done`` under the lock after the flush-end notify)."""
         for kind, fn in (
@@ -248,8 +288,13 @@ class BatchingBackend:
             if not queue:
                 continue
             merged: List[Any] = []
+            now = time.perf_counter()
             for entry in queue:
                 merged.extend(entry.requests)
+                self._queue_wait.labels(kind).observe(now - entry.enqueued)
+            self._flushes.labels(kind, reason).inc()
+            self._batch_fill.labels(kind).observe(len(queue))
+            self._merged_requests.labels(kind).inc(len(merged))
             self.batch_counts[kind] += 1
             try:
                 results = fn(merged)

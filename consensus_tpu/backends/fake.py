@@ -35,6 +35,7 @@ from consensus_tpu.backends.base import (
     ScoreResult,
     TokenCandidate,
 )
+from consensus_tpu.obs.backends import BackendInstruments
 
 _WORDS = (
     "we believe support should public policy community fairness balance "
@@ -75,6 +76,15 @@ def _rng(*parts) -> np.random.Generator:
     return np.random.default_rng(int.from_bytes(_digest(*parts)[:8], "big"))
 
 
+def _pow2_bucket(n: int, minimum: int) -> int:
+    """Next power of two >= max(n, minimum) — mirrors TPUBackend's row and
+    width ladders so fake-run padding metrics have realistic shape."""
+    bucket = minimum
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
 class FakeBackend:
     """Deterministic pseudo-LM implementing the :class:`Backend` protocol."""
 
@@ -85,12 +95,23 @@ class FakeBackend:
     #: fake pipeline exercises the reference's full retry choreography.
     deterministic_greedy = False
 
-    def __init__(self, embed_dim: int = 64, instruction_following: bool = True):
+    def __init__(
+        self,
+        embed_dim: int = 64,
+        instruction_following: bool = True,
+        registry=None,
+    ):
         self.embed_dim = embed_dim
         self.instruction_following = instruction_following
         self.call_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
         # Token-honest accounting mirroring TPUBackend (pseudo-tokens here).
         self.token_counts = {"generated": 0, "scored": 0}
+        # obs: the fake backend records padding/launch events AS IF its
+        # batches padded onto TPUBackend's pow2 grids, so the full metrics
+        # path (registry -> metrics.json -> sweep aggregation) is testable
+        # without hardware.  ``registry`` lets tests isolate from the
+        # process-global registry.
+        self.instruments = BackendInstruments("fake", registry=registry)
 
     # -- generation ---------------------------------------------------------
 
@@ -155,6 +176,10 @@ class FakeBackend:
 
     def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
         self.call_counts["generate"] += len(requests)
+        if requests:
+            rows = _pow2_bucket(len(requests), 8)
+            width = _pow2_bucket(max(r.max_tokens for r in requests), 16)
+            self.instruments.record_launch("generate", (rows, width))
         results = []
         for req in requests:
             prompt = self._full_prompt(req)
@@ -174,6 +199,11 @@ class FakeBackend:
                     text = text[:idx]
             self.token_counts["generated"] += len(self._tokenize(text))
             results.append(GenerationResult(text=text, finish_reason="stop"))
+        if requests:
+            self.instruments.record_padding(
+                "generate_decode", rows, width,
+                sum(len(self._tokenize(r.text)) for r in results),
+            )
         return results
 
     # -- scoring ------------------------------------------------------------
@@ -189,6 +219,14 @@ class FakeBackend:
 
     def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
         self.call_counts["score"] += len(requests)
+        if requests:
+            token_rows = [self._tokenize(r.continuation) for r in requests]
+            rows = _pow2_bucket(len(requests), 8)
+            width = _pow2_bucket(max(len(t) for t in token_rows), 64)
+            self.instruments.record_launch("score", (rows, width))
+            self.instruments.record_padding(
+                "score", rows, width, sum(len(t) for t in token_rows)
+            )
         results = []
         for req in requests:
             context = (
@@ -211,6 +249,10 @@ class FakeBackend:
     ) -> List[List[TokenCandidate]]:
         self.call_counts["next_token"] += len(requests)
         self.token_counts["scored"] += len(requests)
+        if requests:
+            rows = _pow2_bucket(len(requests), 8)
+            self.instruments.record_launch("next_token", (rows, 1))
+            self.instruments.record_padding("next_token", rows, 1, len(requests))
         out: List[List[TokenCandidate]] = []
         for req in requests:
             prompt = self._full_prompt(req)
@@ -240,6 +282,10 @@ class FakeBackend:
 
     def embed(self, texts: Sequence[str]) -> np.ndarray:
         self.call_counts["embed"] += len(texts)
+        if texts:
+            rows = _pow2_bucket(len(texts), 8)
+            self.instruments.record_launch("embed", (rows, 1))
+            self.instruments.record_padding("embed", rows, 1, len(texts))
         vectors = np.stack(
             [_rng("emb", text).normal(size=self.embed_dim) for text in texts]
         )

@@ -105,88 +105,101 @@ def run_pipeline(
     embedding_path = (config.get("models") or {}).get("embedding_model_path")
     embedder = get_embedder(embedding_path, backend)
 
-    # ---- Phase 2a: per-seed comparative ranking -----------------------
-    if not skip_comparative_ranking:
-        logger.info("=== Phase 2a: LLM-judge comparative ranking ===")
-        evaluator = StatementEvaluator(
-            backend,
-            judge_backend=judge_backend_lazy(),
-            llm_judge_model=llm_judge_model,
-            embedder=embedder,
-        )
-        for seed_index, seed in enumerate(sorted(results["seed"].unique())):
-            subset = results[
-                (results["seed"] == seed)
-                & (results["statement"].astype(str).str.strip() != "")
-                & ~results["statement"].astype(str).str.lstrip().str.startswith("[ERROR")
-                & (results["error_message"].fillna("").astype(str).str.strip() == "")
-            ]
-            method_statements = {}
-            for index, row in subset.iterrows():
-                params = {
-                    k: row[k]
-                    for k in subset.columns
-                    if k.startswith("param_") and pd.notna(row[k])
-                }
-                key = create_method_identifier(row["method"], params)
-                method_statements[key] = row["statement"]
-            if len(method_statements) < 2:
-                logger.info("Seed %s: <2 statements, skipping ranking", seed)
-                continue
-            ranking, reasoning, matrix = evaluator.evaluate_comparative_rankings(
-                method_statements, issue, agent_opinions, seed=int(seed)
-            )
-            seed_dir = run_dir / "evaluation" / "llm_judge" / f"seed_{seed_index}"
-            seed_dir.mkdir(parents=True, exist_ok=True)
-            ranking.to_csv(seed_dir / "ranking_results.csv", index=False)
-            reasoning.to_csv(seed_dir / "ranking_reasoning.csv", index=False)
-            with open(seed_dir / "comparative_ranking_matrix.json", "w") as fh:
-                json.dump(matrix, fh, indent=2)
+    # --profile-dir (threaded via config profile_dir): Phase 1 generation
+    # traced its own window inside Experiment.run; the scoring/eval phases
+    # get a separate device-trace window so the two profiles load side by
+    # side in TensorBoard.
+    from consensus_tpu.utils.tracing import device_trace
 
-    # ---- Phase 2b: per-(model x seed) standard evaluation -------------
-    logger.info("=== Phase 2b: standard evaluation ===")
-    # experiment.evaluation_models already resolves the plural key, the
-    # singular evaluation_model back-compat key, and defaults.
-    models = evaluation_models or experiment.evaluation_models or [
-        config.get("models", {}).get("generation_model", "model")
-    ]
-    # Optional per-model backend routing: evaluation_backends:
-    #   {model_name: {name: tpu|fake|api, ...options}}.  Without it every
-    # evaluation model shares the resident generation backend (same scores
-    # under different directory names) — warn so that's a choice, not a trap.
-    eval_backends = config.get("evaluation_backends") or {}
-    if len(models) > 1 and not eval_backends:
-        logger.warning(
-            "%d evaluation models share ONE resident backend — their metrics "
-            "will be identical; set config.evaluation_backends to route "
-            "models to distinct backends",
-            len(models),
-        )
-    # Per-agent judge scores in standard evaluation run only when a judge
-    # backend is configured and --skip-llm-judge wasn't passed (the flag the
-    # reference accepts at run_experiment_with_eval.py:465-509).
-    include_llm_judge = not skip_llm_judge and bool(config.get("judge_backend"))
-    for model in models:
-        model_backend = (
-            get_backend(dict(eval_backends[model]))
-            if model in eval_backends
-            else backend
-        )
-        evaluator = StatementEvaluator(
-            model_backend,
-            evaluation_model=model,
-            judge_backend=judge_backend_lazy() if include_llm_judge else None,
-            llm_judge_model=llm_judge_model,
-            # A path-based embedder is backend-independent — reuse the one
-            # instance instead of re-loading the ST weights per model.
-            embedder=embedder if embedding_path else get_embedder(None, model_backend),
-        )
-        evaluator.evaluate_results_file(
-            str(run_dir / "results.csv"),
-            config=config,
-            include_llm_judge=include_llm_judge,
-        )
-        logger.info("Evaluated with %s", sanitize_model_name(model))
+    profile_dir = config.get("profile_dir") or None
+    eval_profile_dir = (
+        str(pathlib.Path(profile_dir) / f"{run_dir.name}_eval")
+        if profile_dir
+        else None
+    )
+    with device_trace(eval_profile_dir):
+        # ---- Phase 2a: per-seed comparative ranking -------------------
+        if not skip_comparative_ranking:
+            logger.info("=== Phase 2a: LLM-judge comparative ranking ===")
+            evaluator = StatementEvaluator(
+                backend,
+                judge_backend=judge_backend_lazy(),
+                llm_judge_model=llm_judge_model,
+                embedder=embedder,
+            )
+            for seed_index, seed in enumerate(sorted(results["seed"].unique())):
+                subset = results[
+                    (results["seed"] == seed)
+                    & (results["statement"].astype(str).str.strip() != "")
+                    & ~results["statement"].astype(str).str.lstrip().str.startswith("[ERROR")
+                    & (results["error_message"].fillna("").astype(str).str.strip() == "")
+                ]
+                method_statements = {}
+                for index, row in subset.iterrows():
+                    params = {
+                        k: row[k]
+                        for k in subset.columns
+                        if k.startswith("param_") and pd.notna(row[k])
+                    }
+                    key = create_method_identifier(row["method"], params)
+                    method_statements[key] = row["statement"]
+                if len(method_statements) < 2:
+                    logger.info("Seed %s: <2 statements, skipping ranking", seed)
+                    continue
+                ranking, reasoning, matrix = evaluator.evaluate_comparative_rankings(
+                    method_statements, issue, agent_opinions, seed=int(seed)
+                )
+                seed_dir = run_dir / "evaluation" / "llm_judge" / f"seed_{seed_index}"
+                seed_dir.mkdir(parents=True, exist_ok=True)
+                ranking.to_csv(seed_dir / "ranking_results.csv", index=False)
+                reasoning.to_csv(seed_dir / "ranking_reasoning.csv", index=False)
+                with open(seed_dir / "comparative_ranking_matrix.json", "w") as fh:
+                    json.dump(matrix, fh, indent=2)
+
+        # ---- Phase 2b: per-(model x seed) standard evaluation ---------
+        logger.info("=== Phase 2b: standard evaluation ===")
+        # experiment.evaluation_models already resolves the plural key, the
+        # singular evaluation_model back-compat key, and defaults.
+        models = evaluation_models or experiment.evaluation_models or [
+            config.get("models", {}).get("generation_model", "model")
+        ]
+        # Optional per-model backend routing: evaluation_backends:
+        #   {model_name: {name: tpu|fake|api, ...options}}.  Without it every
+        # evaluation model shares the resident generation backend (same scores
+        # under different directory names) — warn so that's a choice, not a trap.
+        eval_backends = config.get("evaluation_backends") or {}
+        if len(models) > 1 and not eval_backends:
+            logger.warning(
+                "%d evaluation models share ONE resident backend — their metrics "
+                "will be identical; set config.evaluation_backends to route "
+                "models to distinct backends",
+                len(models),
+            )
+        # Per-agent judge scores in standard evaluation run only when a judge
+        # backend is configured and --skip-llm-judge wasn't passed (the flag the
+        # reference accepts at run_experiment_with_eval.py:465-509).
+        include_llm_judge = not skip_llm_judge and bool(config.get("judge_backend"))
+        for model in models:
+            model_backend = (
+                get_backend(dict(eval_backends[model]))
+                if model in eval_backends
+                else backend
+            )
+            evaluator = StatementEvaluator(
+                model_backend,
+                evaluation_model=model,
+                judge_backend=judge_backend_lazy() if include_llm_judge else None,
+                llm_judge_model=llm_judge_model,
+                # A path-based embedder is backend-independent — reuse the one
+                # instance instead of re-loading the ST weights per model.
+                embedder=embedder if embedding_path else get_embedder(None, model_backend),
+            )
+            evaluator.evaluate_results_file(
+                str(run_dir / "results.csv"),
+                config=config,
+                include_llm_judge=include_llm_judge,
+            )
+            logger.info("Evaluated with %s", sanitize_model_name(model))
 
     # ---- Phase 3: aggregation (improved, basic fallback) --------------
     logger.info("=== Phase 3: aggregation ===")

@@ -69,6 +69,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "(no EOS/terminator early exit) so random-weight timings measure "
         "the full-budget workload; never use for quality runs",
     )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="emit a TensorBoard-loadable jax.profiler device trace per "
+        "cell under this directory (threads device_trace through the "
+        "generate and score/eval phases)",
+    )
+    parser.add_argument(
+        "--metrics-out", default=None,
+        help="write a sweep-level metrics aggregate (merge of every cell's "
+        "metrics.json delta) to this path",
+    )
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args(argv)
 
@@ -80,8 +91,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         logger.error("No configs matched under %s", args.configs_root)
         return 1
 
+    overrides = {}
+    if args.timing_pin_budget:
+        overrides["timing_pin_budget"] = True
+    if args.profile_dir:
+        overrides["profile_dir"] = args.profile_dir
+
     logger.info("Running %d configs", len(configs))
     failures = 0
+    cell_dirs: List[pathlib.Path] = []
     for i, config in enumerate(configs, 1):
         logger.info("[%d/%d] %s", i, len(configs), config)
         start = time.perf_counter()
@@ -89,10 +107,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             run_dir = run_pipeline(
                 str(config),
                 skip_comparative_ranking=args.skip_comparative_ranking,
-                config_overrides=(
-                    {"timing_pin_budget": True} if args.timing_pin_budget else None
-                ),
+                config_overrides=overrides or None,
             )
+            cell_dirs.append(pathlib.Path(run_dir))
             logger.info(
                 "[%d/%d] done in %.1fs -> %s",
                 i, len(configs), time.perf_counter() - start, run_dir,
@@ -100,7 +117,56 @@ def main(argv: Optional[List[str]] = None) -> int:
         except Exception:
             logger.exception("[%d/%d] FAILED: %s", i, len(configs), config)
             failures += 1
+    if args.metrics_out:
+        write_sweep_metrics(cell_dirs, pathlib.Path(args.metrics_out))
     return 1 if failures else 0
+
+
+def write_sweep_metrics(
+    cell_dirs: List[pathlib.Path], out_path: pathlib.Path
+) -> Optional[dict]:
+    """Aggregate every cell's metrics.json DELTA into one sweep snapshot.
+
+    Cell deltas are exact per-cell windows of the process-global registry
+    (experiment.py records after-before), so summing them reconstructs the
+    sweep total without double-counting — plus sweep-level derived
+    padding_efficiency / bucket_recompiles and a per-cell span-tree index.
+    """
+    import json
+
+    from consensus_tpu.obs import (
+        bucket_recompiles,
+        merge_snapshots,
+        padding_efficiency,
+    )
+
+    cells = []
+    for run_dir in cell_dirs:
+        path = run_dir / "metrics.json"
+        if not path.exists():
+            logger.warning("no metrics.json under %s; skipping", run_dir)
+            continue
+        cells.append((run_dir.name, json.loads(path.read_text())))
+    if not cells:
+        logger.warning("no cell metrics found; not writing %s", out_path)
+        return None
+    merged = merge_snapshots([payload["metrics"] for _, payload in cells])
+    aggregate = {
+        "schema": "consensus_tpu.metrics.sweep.v1",
+        "cells": [name for name, _ in cells],
+        "metrics": merged,
+        "derived": {
+            "padding_efficiency": padding_efficiency(merged),
+            "bucket_recompiles": bucket_recompiles(merged),
+        },
+        "spans_by_cell": {
+            name: payload.get("spans", []) for name, payload in cells
+        },
+    }
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(json.dumps(aggregate, indent=2))
+    logger.info("Sweep metrics aggregate -> %s", out_path)
+    return aggregate
 
 
 if __name__ == "__main__":

@@ -42,7 +42,14 @@ import yaml
 from consensus_tpu.backends import get_backend
 from consensus_tpu.backends.base import Backend
 from consensus_tpu.methods import get_method_generator
-from consensus_tpu.utils.tracing import get_tracer
+from consensus_tpu.obs import (
+    bucket_recompiles,
+    diff_snapshots,
+    diff_span_paths,
+    get_registry,
+    padding_efficiency,
+)
+from consensus_tpu.utils.tracing import device_trace, get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -178,45 +185,67 @@ class Experiment:
 
         # Token-honest cell accounting: the backend may be shared across an
         # in-process sweep, so record deltas around this experiment's runs.
+        # Metrics and spans follow the same delta discipline: the registry
+        # and tracer are process-global, so this cell's metrics.json records
+        # (after - before), which run_sweep can sum back together exactly.
         tokens_before = dict(getattr(self.backend, "token_counts", {}) or {})
         wall_start = time.perf_counter()
+        tracer = get_tracer()
+        metrics_before = get_registry().snapshot()
+        spans_before = tracer.snapshot_paths()
 
         concurrent = bool(self.config.get("concurrent_execution", True))
         max_workers = int(self.config.get("max_concurrent_methods", 4))
 
-        if concurrent and len(runs) > 1 and max_workers > 1:
-            # Independent combos (all seeds flattened) share device batches
-            # through the BatchingBackend; results stay bit-identical to
-            # sequential execution (per-request PRNG keys).
-            from concurrent.futures import ThreadPoolExecutor
+        # --profile-dir: a TensorBoard-loadable device profile per cell,
+        # namespaced by run-dir name so sweep cells don't clobber each other.
+        profile_dir = self.config.get("profile_dir") or None
+        if profile_dir:
+            profile_dir = str(pathlib.Path(profile_dir) / self.run_dir.name)
 
-            from consensus_tpu.backends.batching import BatchingBackend
+        with tracer.span("experiment"), device_trace(profile_dir):
+            # Worker threads adopt this path so their generate/<method>
+            # spans nest under this experiment in the span tree.
+            parent_path = tracer.current_path()
+            if concurrent and len(runs) > 1 and max_workers > 1:
+                # Independent combos (all seeds flattened) share device
+                # batches through the BatchingBackend; results stay
+                # bit-identical to sequential execution (per-request PRNG
+                # keys).
+                from concurrent.futures import ThreadPoolExecutor
 
-            batching = BatchingBackend(
-                self.backend,
-                flush_ms=float(self.config.get("batch_flush_ms", 10.0)),
-                expected_sessions=min(max_workers, len(runs)),
-            )
+                from consensus_tpu.backends.batching import BatchingBackend
 
-            def worker(run):
-                with batching.session():
+                batching = BatchingBackend(
+                    self.backend,
+                    flush_ms=float(self.config.get("batch_flush_ms", 10.0)),
+                    expected_sessions=min(max_workers, len(runs)),
+                )
+
+                def worker(run):
+                    with tracer.adopt(parent_path), batching.session():
+                        logger.info(
+                            "Running %s with %s", run["method"], run["config"]
+                        )
+                        return self._run_one(
+                            run["method"], run["config"], run["seed"],
+                            backend=batching,
+                        )
+
+                with ThreadPoolExecutor(max_workers=max_workers) as pool:
+                    rows = list(pool.map(worker, runs))
+                self.last_batch_counts = dict(batching.batch_counts)
+                logger.info(
+                    "Device batches issued: %s (%d runs, %d workers)",
+                    batching.batch_counts, len(runs), max_workers,
+                )
+            else:
+                rows = []
+                for run in runs:
                     logger.info("Running %s with %s", run["method"], run["config"])
-                    return self._run_one(
-                        run["method"], run["config"], run["seed"], backend=batching
+                    rows.append(
+                        self._run_one(run["method"], run["config"], run["seed"])
                     )
-
-            with ThreadPoolExecutor(max_workers=max_workers) as pool:
-                rows = list(pool.map(worker, runs))
-            self.last_batch_counts = dict(batching.batch_counts)
-            logger.info(
-                "Device batches issued: %s (%d runs, %d workers)",
-                batching.batch_counts, len(runs), max_workers,
-            )
-        else:
-            rows = []
-            for run in runs:
-                logger.info("Running %s with %s", run["method"], run["config"])
-                rows.append(self._run_one(run["method"], run["config"], run["seed"]))
 
         frame = pd.DataFrame(rows)
         lead = [c for c in _LEAD_COLUMNS if c in frame.columns]
@@ -224,9 +253,38 @@ class Experiment:
         frame = frame[lead + rest]
         frame.to_csv(self.run_dir / "results.csv", index=False)
         get_tracer().write(self.run_dir / "timing.json")
+        self._write_metrics(metrics_before, spans_before)
         self._write_token_counts(tokens_before, wall_start, len(frame))
         logger.info("Saved %d rows to %s", len(frame), self.run_dir / "results.csv")
         return frame
+
+    def _write_metrics(self, metrics_before, spans_before) -> None:
+        """This cell's observability artifacts.
+
+        ``metrics.json`` (schema ``consensus_tpu.metrics.v1``) holds the
+        registry DELTA for this cell plus the nested span tree and the two
+        derived headline numbers; ``metrics.prom`` is the cumulative
+        process registry in Prometheus text exposition (what a scrape
+        endpoint would serve)."""
+        import json
+
+        registry = get_registry()
+        delta = diff_snapshots(metrics_before, registry.snapshot())
+        span_delta = diff_span_paths(
+            spans_before, get_tracer().snapshot_paths()
+        )
+        payload = {
+            "schema": "consensus_tpu.metrics.v1",
+            "spans": get_tracer().tree(span_delta),
+            "metrics": delta,
+            "derived": {
+                "padding_efficiency": padding_efficiency(delta),
+                "bucket_recompiles": bucket_recompiles(delta),
+            },
+        }
+        with open(self.run_dir / "metrics.json", "w") as fh:
+            json.dump(payload, fh, indent=2)
+        (self.run_dir / "metrics.prom").write_text(registry.to_prometheus())
 
     def _write_token_counts(
         self, before: Dict[str, int], wall_start: float, statements: int

@@ -339,7 +339,7 @@ class HabermasMachineGenerator(BaseGenerator):
             # device.  XLA does not promise cross-shape accumulation-order
             # stability in general; validate the premise on the target
             # device with scripts/greedy_batch_invariance_check.py (same
-            # greedy request re-issued at batch widths 1/4/16, asserts
+            # greedy request re-issued at batch widths 1/8/9/32/64, asserts
             # token-identical; writes reports/greedy_batch_invariance.md)
             # before relying on the elision.  If the check fails for a
             # model/config, drop this break.

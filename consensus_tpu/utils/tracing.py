@@ -2,66 +2,29 @@
 
 The reference's only observability is coarse wall-clock columns scattered
 through results CSVs (``generation_time_s``, ``evaluation_time_s``, … —
-SURVEY §5.1).  This module makes timing a subsystem: named spans accumulate
-into a process-wide registry the experiment engine snapshots into
-``timing.json`` per run, and ``device_trace`` wraps ``jax.profiler.trace``
-so any phase can emit a TensorBoard-loadable device profile.
+SURVEY §5.1).  Spans now live in :mod:`consensus_tpu.obs.spans`, which
+records them hierarchically (parent/child paths) while this module's
+original surface stays intact: ``Tracer`` is the hierarchical tracer
+(its flat ``summary()``/``write()`` views aggregate by leaf name, so
+``timing.json`` keeps its shape), ``get_tracer()`` returns the process
+global, and ``device_trace`` wraps ``jax.profiler.trace`` so any phase
+can emit a TensorBoard-loadable device profile.
 """
 
 from __future__ import annotations
 
 import contextlib
-import json
-import pathlib
-import threading
-import time
-from typing import Dict, Iterator, Optional
+from typing import Iterator, Optional
+
+from consensus_tpu.obs.spans import SpanTracer, get_span_tracer
+
+# Backward-compatible name: existing call sites construct Tracer() directly
+# and rely on the flat summary()/write() contract, which SpanTracer keeps.
+Tracer = SpanTracer
 
 
-class Tracer:
-    """Thread-safe accumulator of named wall-clock spans."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._totals: Dict[str, float] = {}
-        self._counts: Dict[str, int] = {}
-
-    @contextlib.contextmanager
-    def span(self, name: str) -> Iterator[None]:
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            elapsed = time.perf_counter() - start
-            with self._lock:
-                self._totals[name] = self._totals.get(name, 0.0) + elapsed
-                self._counts[name] = self._counts.get(name, 0) + 1
-
-    def summary(self) -> Dict[str, Dict[str, float]]:
-        with self._lock:
-            return {
-                name: {
-                    "total_s": round(self._totals[name], 4),
-                    "count": self._counts[name],
-                    "mean_s": round(self._totals[name] / self._counts[name], 4),
-                }
-                for name in sorted(self._totals)
-            }
-
-    def write(self, path: str | pathlib.Path) -> None:
-        pathlib.Path(path).write_text(json.dumps(self.summary(), indent=2))
-
-    def reset(self) -> None:
-        with self._lock:
-            self._totals.clear()
-            self._counts.clear()
-
-
-_GLOBAL = Tracer()
-
-
-def get_tracer() -> Tracer:
-    return _GLOBAL
+def get_tracer() -> SpanTracer:
+    return get_span_tracer()
 
 
 @contextlib.contextmanager

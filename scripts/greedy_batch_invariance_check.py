@@ -9,8 +9,8 @@ to batch width on the real device, which XLA does not promise in general
 (accumulation order may differ across shapes).
 
 This script tests exactly that: the same greedy request decoded at batch
-widths 1, 4, and 16 (padded with distinct sibling prompts, target row
-first/last), asserting token-identical output across all compositions.
+widths 1, 8, 9, 32, and 64 (padded with distinct sibling prompts, target
+row first/last), asserting token-identical output across all compositions.
 Writes ``reports/greedy_batch_invariance.md`` + ``.json``.
 
 Usage: PYTHONPATH=/root/.axon_site:/root/repo \

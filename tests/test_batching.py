@@ -170,6 +170,82 @@ class TestBatchingBackend:
         assert errors == ["device on fire", "device on fire"]
 
 
+class TestBatchingMetrics:
+    def test_queue_wait_and_batch_fill_recorded_under_concurrency(self):
+        """Concurrent sessions leave an observability trail: queue-wait
+        samples per merged request, batch-fill = sessions per flush, a
+        flush-reason counter, and merged-request totals — recorded into
+        the injected registry, not the process-global one."""
+        from consensus_tpu.obs import Registry
+
+        registry = Registry()
+        counting = CountingBackend()
+        batching = BatchingBackend(
+            counting, flush_ms=50.0, expected_sessions=3, registry=registry
+        )
+        barrier = threading.Barrier(3)
+
+        def worker(tag):
+            with batching.session():
+                barrier.wait()
+                batching.generate(
+                    [GenerationRequest(user_prompt=f"p{tag}", max_tokens=4, seed=tag)]
+                )
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert counting.batches["generate"] == 1
+
+        families = registry.snapshot()["families"]
+
+        def series(name, **labels):
+            for entry in families[name]["series"]:
+                if all(entry["labels"].get(k) == v for k, v in labels.items()):
+                    return entry
+            raise AssertionError(f"no {name} series with {labels}: {families[name]}")
+
+        wait = series("batching_queue_wait_seconds", kind="generate")
+        assert wait["count"] == 3  # one sample per merged session call
+        assert wait["sum"] >= 0.0 and wait["max"] < 30.0
+
+        fill = series("batching_batch_fill_sessions", kind="generate")
+        assert fill["count"] == 1  # one flush
+        assert fill["min"] == fill["max"] == 3.0  # all 3 sessions merged
+
+        merged = series("batching_merged_requests_total", kind="generate")
+        assert merged["value"] == 3.0
+
+        reasons = {
+            s["labels"]["reason"]: s["value"]
+            for s in families["batching_flushes_total"]["series"]
+            if s["labels"]["kind"] == "generate"
+        }
+        assert sum(reasons.values()) == 1.0
+        assert set(reasons) <= {"all_blocked", "timeout"}
+
+    def test_timeout_flush_reason_recorded(self):
+        """A lone session (below expected_sessions) can only flush via the
+        quiescence timeout — the reason label must say so."""
+        from consensus_tpu.obs import Registry
+
+        registry = Registry()
+        batching = BatchingBackend(
+            CountingBackend(), flush_ms=5.0, expected_sessions=4,
+            registry=registry,
+        )
+        with batching.session():
+            batching.score([ScoreRequest(context="ctx", continuation=" more")])
+        families = registry.snapshot()["families"]
+        reasons = {
+            (s["labels"]["kind"], s["labels"]["reason"]): s["value"]
+            for s in families["batching_flushes_total"]["series"]
+        }
+        assert reasons == {("score", "timeout"): 1.0}
+
+
 class TestExperimentConcurrency:
     CONFIG = {
         "experiment_name": "batch_test",

@@ -28,6 +28,8 @@ def test_golden_tree_is_complete():
         "results.csv",
         "timing.json",
         "token_counts.json",
+        "metrics.json",
+        "metrics.prom",
         "evaluation/improved_aggregate/aggregated_metrics.csv",
         "evaluation/improved_aggregate/aggregated_metrics_raw.csv",
         "evaluation/fake-lm/seed_0/evaluation_results.csv",

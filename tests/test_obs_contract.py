@@ -1,0 +1,222 @@
+"""End-to-end observability contract on the FakeBackend pipeline.
+
+The unit tests (test_obs_metrics.py) pin the primitives; this file pins
+the *artifacts*: a concurrent fake-backend ``Experiment`` must leave a
+schema-valid ``metrics.json`` whose derived numbers are nonzero (padding
+efficiency, recompiles) and whose registry delta shows the batching
+backend actually merged sessions (batch-fill, queue-wait), plus a
+``metrics.prom`` scrape file; the sweep CLI must roll per-cell deltas
+into one aggregate via ``--metrics-out``; and ``bench.py`` (slow, real
+stack) must keep emitting exactly one parseable JSON line with the new
+``padding_efficiency`` / ``bucket_recompiles`` keys.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+ISSUE = "Should the library extend weekend hours?"
+OPINIONS = {
+    "Agent 1": "Weekend mornings are the only time I can visit.",
+    "Agent 2": "Extended hours cost money we do not have.",
+    "Agent 3": "Students need quiet space on Sundays.",
+}
+
+
+@pytest.fixture(scope="module")
+def fake_run(tmp_path_factory):
+    """One concurrent fake-backend experiment; all assertions share it."""
+    from consensus_tpu.experiment import Experiment
+
+    config = {
+        "experiment_name": "obs_contract",
+        "seed": 11,
+        "num_seeds": 2,
+        "backend": "fake",
+        # A non-default option gives get_backend a distinct cache key -> a
+        # COLD FakeBackend whose first launches count as compiles, however
+        # many fake-backend tests ran earlier in this process.
+        "backend_options": {"embed_dim": 48},
+        "models": {"generation_model": "fake-lm", "evaluation_models": ["fake-lm"]},
+        "scenario": {"issue": ISSUE, "agent_opinions": dict(OPINIONS)},
+        "methods_to_run": ["zero_shot", "best_of_n"],
+        "best_of_n": {"n": 2, "max_tokens": 16},
+        "zero_shot": {"max_tokens": 16},
+        "concurrent_execution": True,
+        "output_dir": str(tmp_path_factory.mktemp("obs_contract")),
+    }
+    experiment = Experiment(config)
+    experiment.run()
+    payload = json.loads((experiment.run_dir / "metrics.json").read_text())
+    return experiment.run_dir, payload
+
+
+def _series(metrics, name):
+    assert name in metrics["families"], (
+        f"metrics.json missing {name}; has {sorted(metrics['families'])}"
+    )
+    return metrics["families"][name]["series"]
+
+
+class TestMetricsJson:
+    def test_schema_and_derived_values(self, fake_run):
+        _, payload = fake_run
+        assert payload["schema"] == "consensus_tpu.metrics.v1"
+        derived = payload["derived"]
+        assert 0.0 < derived["padding_efficiency"] < 1.0
+        assert derived["bucket_recompiles"] >= 1
+
+    def test_padding_series_nonzero(self, fake_run):
+        _, payload = fake_run
+        useful = _series(payload["metrics"], "backend_padding_useful_tokens_total")
+        allocated = _series(
+            payload["metrics"], "backend_padding_allocated_tokens_total"
+        )
+        assert sum(s["value"] for s in useful) > 0
+        assert sum(s["value"] for s in allocated) >= sum(
+            s["value"] for s in useful
+        )
+        assert all(s["labels"]["backend"] == "fake" for s in useful)
+
+    def test_batching_merged_sessions(self, fake_run):
+        """Concurrent methods must actually co-batch: at least one flush
+        carried >1 session, and every merged call has a queue-wait sample."""
+        _, payload = fake_run
+        fill = _series(payload["metrics"], "batching_batch_fill_sessions")
+        assert sum(s["count"] for s in fill) >= 1
+        assert max(s["max"] for s in fill) > 1
+        wait = _series(payload["metrics"], "batching_queue_wait_seconds")
+        assert sum(s["count"] for s in wait) >= 2
+        assert all(s["sum"] >= 0 for s in wait)
+
+    def test_span_tree_is_nested(self, fake_run):
+        _, payload = fake_run
+        roots = {node["name"]: node for node in payload["spans"]}
+        assert "experiment" in roots
+        experiment = roots["experiment"]
+        assert experiment["count"] == 1
+        children = {c["name"] for c in experiment["children"]}
+        assert any(name.startswith("generate/") for name in children), children
+        # Children are concurrent pool workers, so their summed elapsed may
+        # exceed the parent's wall time — only existence/counts are pinned.
+        assert all(c["count"] >= 1 for c in experiment["children"])
+
+    def test_prometheus_file_written(self, fake_run):
+        run_dir, _ = fake_run
+        text = (run_dir / "metrics.prom").read_text()
+        assert "# TYPE backend_padding_useful_tokens_total counter" in text
+        assert "# TYPE batching_queue_wait_seconds histogram" in text
+        assert text.endswith("\n")
+
+    def test_timing_json_contract_untouched(self, fake_run):
+        """The pre-obs artifact keeps its flat name -> totals shape."""
+        run_dir, _ = fake_run
+        timing = json.loads((run_dir / "timing.json").read_text())
+        for entry in timing.values():
+            assert {"total_s", "count", "mean_s"} <= set(entry)
+
+
+class TestSweepAggregate:
+    def test_metrics_out_merges_cells(self, tmp_path, monkeypatch):
+        from consensus_tpu.cli.run_sweep import main
+
+        for idx, method in enumerate(("quick_bon", "quick_zero")):
+            section = (
+                {"best_of_n": {"n": 2, "max_tokens": 8, "seed": 1}}
+                if method == "quick_bon"
+                else {"zero_shot": {"max_tokens": 8, "seed": 1}}
+            )
+            cfg = {
+                "experiment_name": f"obs_sweep_{method}",
+                "seed": 7,
+                "num_seeds": 1,
+                "backend": "fake",
+                "models": {
+                    "generation_model": "fake",
+                    "evaluation_models": ["fake"],
+                },
+                "scenario": {"issue": ISSUE, "agent_opinions": dict(OPINIONS)},
+                "methods_to_run": list(section),
+                "output_dir": str(tmp_path / "out"),
+                **section,
+            }
+            path = tmp_path / "gemma" / "scenario_1" / f"{method}.yaml"
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(yaml.safe_dump(cfg))
+
+        monkeypatch.chdir(tmp_path)
+        out = tmp_path / "sweep_metrics.json"
+        rc = main(
+            [
+                "--configs-root", str(tmp_path),
+                "--skip-comparative-ranking",
+                "--metrics-out", str(out),
+                "--quiet",
+            ]
+        )
+        assert rc == 0
+        aggregate = json.loads(out.read_text())
+        assert aggregate["schema"] == "consensus_tpu.metrics.sweep.v1"
+        assert len(aggregate["cells"]) == 2
+        assert set(aggregate["spans_by_cell"]) == set(aggregate["cells"])
+        useful = _series(
+            aggregate["metrics"], "backend_padding_useful_tokens_total"
+        )
+        # The aggregate is the SUM over cells: at least as much useful
+        # work as either cell alone reported.
+        per_cell = []
+        for cell_dir in (tmp_path / "out").iterdir():
+            cell = json.loads((cell_dir / "metrics.json").read_text())
+            series = cell["metrics"]["families"][
+                "backend_padding_useful_tokens_total"
+            ]["series"]
+            per_cell.append(sum(s["value"] for s in series))
+        assert sum(s["value"] for s in useful) == pytest.approx(sum(per_cell))
+        assert aggregate["derived"]["padding_efficiency"] is not None
+
+
+@pytest.mark.slow
+def test_bench_emits_one_parseable_json_line_with_obs_keys():
+    """Real-stack bench contract (~3 min on CPU with the tiny model):
+    stdout's final line is the ONLY json payload, and it now carries the
+    observability-derived keys alongside the throughput headline."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_MODEL="tiny-gemma2",
+        BENCH_N="2",
+        BENCH_TOKENS="8",
+        BENCH_CONCURRENT="2",
+        BENCH_TRIALS="1",
+        BENCH_QUANT="none",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    payloads = []
+    for line in lines:
+        try:
+            payloads.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    assert len(payloads) == 1, f"expected exactly one JSON line, got {len(payloads)}"
+    (payload,) = payloads
+    assert payload["metric"] == "best_of_n_statements_per_sec"
+    extra = payload["extra"]
+    assert 0.0 < extra["padding_efficiency"] <= 1.0
+    assert extra["bucket_recompiles"] >= 1
+    assert extra["tokens_per_sec"] > 0
+    assert "bon_throughput_tokens_all_trials" in extra
+    assert "bon_throughput_walls_sum_s" in extra

@@ -1,0 +1,301 @@
+"""Unit contract for ``consensus_tpu.obs``: metrics and spans.
+
+Pins the parts downstream artifacts depend on: thread-safety of the
+locked float adds (metrics.json totals must be exact under the batching
+backend's concurrency), inclusive-``le`` histogram bucketing, the exact
+Prometheus text exposition (metrics.prom is scraped verbatim), span-tree
+nesting across threads via ``adopt``, and the snapshot algebra
+(``diff_snapshots``/``merge_snapshots``) that run_sweep uses to roll
+per-cell deltas into one aggregate.
+"""
+
+import json
+import threading
+
+import pytest
+
+from consensus_tpu.obs import (
+    DEFAULT_COUNT_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    Registry,
+    SpanTracer,
+    diff_snapshots,
+    diff_span_paths,
+    exponential_buckets,
+    get_registry,
+    get_span_tracer,
+    merge_snapshots,
+)
+
+
+def _series(snapshot, name, **labels):
+    for entry in snapshot["families"][name]["series"]:
+        if all(entry["labels"].get(k) == v for k, v in labels.items()):
+            return entry
+    raise AssertionError(f"no {name} series matching {labels}")
+
+
+class TestConcurrency:
+    def test_concurrent_counter_increments_are_exact(self):
+        registry = Registry()
+        counter = registry.counter("hits_total", labels=("worker",))
+        n_threads, n_incs = 8, 500
+        barrier = threading.Barrier(n_threads)
+
+        def worker(tag):
+            child = counter.labels(tag % 2)
+            barrier.wait()
+            for _ in range(n_incs):
+                child.inc()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = registry.snapshot()
+        total = sum(s["value"] for s in snap["families"]["hits_total"]["series"])
+        assert total == n_threads * n_incs
+        assert _series(snap, "hits_total", worker="0")["value"] == 4 * n_incs
+
+    def test_concurrent_histogram_observations_are_exact(self):
+        registry = Registry()
+        hist = registry.histogram("lat_seconds", buckets=(1.0, 10.0))
+        n_threads, n_obs = 8, 400
+        barrier = threading.Barrier(n_threads)
+
+        def worker():
+            barrier.wait()
+            for _ in range(n_obs):
+                hist.observe(0.5)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        series = _series(registry.snapshot(), "lat_seconds")
+        assert series["count"] == n_threads * n_obs
+        assert series["sum"] == pytest.approx(0.5 * n_threads * n_obs)
+        assert series["bucket_counts"] == [n_threads * n_obs, 0, 0]
+
+
+class TestHistogramBuckets:
+    def test_boundaries_are_inclusive_upper_bounds(self):
+        """Prometheus ``le`` semantics: a value exactly on a boundary lands
+        in that boundary's bucket, one past it in the next."""
+        registry = Registry()
+        hist = registry.histogram("h", buckets=(1.0, 2.0, 4.0))
+        for value in (1.0, 2.0, 2.0000001, 4.0, 100.0):
+            hist.observe(value)
+        series = _series(registry.snapshot(), "h")
+        #              le=1  le=2  le=4  +Inf
+        assert series["bucket_counts"] == [1, 1, 2, 1]
+        assert series["count"] == 5
+        assert series["min"] == 1.0 and series["max"] == 100.0
+
+    def test_exponential_buckets_and_defaults(self):
+        assert exponential_buckets(1.0, 2.0, 4) == (1.0, 2.0, 4.0, 8.0)
+        with pytest.raises(ValueError):
+            exponential_buckets(0.0, 2.0, 4)
+        assert len(DEFAULT_TIME_BUCKETS) == 20
+        assert DEFAULT_TIME_BUCKETS[0] == pytest.approx(1e-4)
+        assert DEFAULT_COUNT_BUCKETS[0] == 1.0
+
+    def test_counter_rejects_negative_and_kind_mismatch_raises(self):
+        registry = Registry()
+        counter = registry.counter("c")
+        with pytest.raises(ValueError):
+            counter.inc(-1)
+        with pytest.raises(ValueError):
+            registry.gauge("c")
+        with pytest.raises(ValueError):
+            registry.counter("c", labels=("extra",))
+
+
+class TestPrometheusExposition:
+    def _demo_registry(self):
+        registry = Registry()
+        requests = registry.counter(
+            "demo_requests_total", help="Requests served.", labels=("method",)
+        )
+        requests.labels("GET").inc()
+        requests.labels("GET").inc(2)
+        requests.labels("POST").inc()
+        registry.gauge("demo_inflight", help="In-flight requests.").set(3)
+        latency = registry.histogram(
+            "demo_latency_seconds",
+            help="Latency.",
+            labels=("method",),
+            buckets=(1.0, 2.0, 4.0),
+        )
+        for value in (1.0, 3.0, 100.0):  # boundary, mid, overflow
+            latency.labels("GET").observe(value)
+        return registry
+
+    GOLDEN = """\
+# HELP demo_inflight In-flight requests.
+# TYPE demo_inflight gauge
+demo_inflight 3
+# HELP demo_latency_seconds Latency.
+# TYPE demo_latency_seconds histogram
+demo_latency_seconds_bucket{method="GET",le="1"} 1
+demo_latency_seconds_bucket{method="GET",le="2"} 1
+demo_latency_seconds_bucket{method="GET",le="4"} 2
+demo_latency_seconds_bucket{method="GET",le="+Inf"} 3
+demo_latency_seconds_sum{method="GET"} 104
+demo_latency_seconds_count{method="GET"} 3
+# HELP demo_requests_total Requests served.
+# TYPE demo_requests_total counter
+demo_requests_total{method="GET"} 3
+demo_requests_total{method="POST"} 1
+"""
+
+    def test_golden_text(self):
+        assert self._demo_registry().to_prometheus() == self.GOLDEN
+
+    def test_exposition_round_trips_against_snapshot(self):
+        """Parse the text back sample-by-sample and check every value
+        against the snapshot — the two export surfaces must agree."""
+        registry = self._demo_registry()
+        samples = {}
+        for line in registry.to_prometheus().splitlines():
+            if line.startswith("#"):
+                continue
+            sample, value = line.rsplit(" ", 1)
+            samples[sample] = float(value)
+        snap = registry.snapshot()
+        get = _series(snap, "demo_requests_total", method="GET")
+        assert samples['demo_requests_total{method="GET"}'] == get["value"]
+        hist = _series(snap, "demo_latency_seconds", method="GET")
+        assert samples['demo_latency_seconds_count{method="GET"}'] == hist["count"]
+        assert samples['demo_latency_seconds_sum{method="GET"}'] == hist["sum"]
+        assert (
+            samples['demo_latency_seconds_bucket{method="GET",le="+Inf"}']
+            == hist["count"]
+        )
+
+    def test_label_values_are_escaped(self):
+        registry = Registry()
+        registry.counter("c", labels=("p",)).labels('say "hi"\n\\x').inc()
+        text = registry.to_prometheus()
+        assert r'c{p="say \"hi\"\n\\x"} 1' in text
+
+    def test_snapshot_is_json_serializable(self):
+        payload = json.dumps(self._demo_registry().snapshot())
+        assert "demo_requests_total" in payload
+
+
+class TestSpans:
+    def test_tree_nests_and_summary_stays_flat(self):
+        tracer = SpanTracer()
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                pass
+            with tracer.span("inner"):
+                pass
+        tree = tracer.tree()
+        assert [n["name"] for n in tree] == ["outer"]
+        (outer,) = tree
+        assert [(c["name"], c["count"]) for c in outer["children"]] == [
+            ("inner", 2)
+        ]
+        summary = tracer.summary()
+        assert summary["outer"]["count"] == 1
+        assert summary["inner"]["count"] == 2
+        assert summary["inner"]["total_s"] <= summary["outer"]["total_s"]
+
+    def test_adopt_grafts_worker_threads_under_parent(self):
+        """The experiment engine's pattern: pool workers adopt the
+        ``experiment`` span's path so their spans nest under it."""
+        tracer = SpanTracer()
+        with tracer.span("experiment"):
+            parent = tracer.current_path()
+
+            def worker():
+                with tracer.adopt(parent), tracer.span("generate"):
+                    pass
+
+            threads = [threading.Thread(target=worker) for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        (root,) = tracer.tree()
+        assert root["name"] == "experiment"
+        (child,) = root["children"]
+        assert (child["name"], child["count"]) == ("generate", 3)
+
+    def test_orphan_paths_fall_back_to_root(self):
+        tracer = SpanTracer()
+        with tracer.span("a"), tracer.span("b"):
+            pass
+        window = diff_span_paths({("a",): (0.0, 1)}, tracer.snapshot_paths())
+        # "a" has no new samples in the window, so ("a","b") is an orphan.
+        (root,) = tracer.tree(window)
+        assert root["name"] == "b" and root["children"] == []
+
+    def test_diff_span_paths_drops_unsampled(self):
+        tracer = SpanTracer()
+        with tracer.span("x"):
+            pass
+        before = tracer.snapshot_paths()
+        with tracer.span("y"):
+            pass
+        delta = diff_span_paths(before, tracer.snapshot_paths())
+        assert set(delta) == {("y",)}
+
+
+class TestSnapshotAlgebra:
+    def test_diff_then_merge_recovers_totals(self):
+        registry = Registry()
+        counter = registry.counter("n_total", labels=("k",))
+        hist = registry.histogram("t_seconds", buckets=(1.0, 2.0))
+
+        counter.labels("a").inc(5)
+        hist.observe(0.5)
+        cut = registry.snapshot()
+        counter.labels("a").inc(2)
+        counter.labels("b").inc(1)
+        hist.observe(1.5)
+        hist.observe(9.0)
+        delta = diff_snapshots(cut, registry.snapshot())
+
+        assert _series(delta, "n_total", k="a")["value"] == 2
+        assert _series(delta, "n_total", k="b")["value"] == 1
+        h = _series(delta, "t_seconds")
+        assert h["count"] == 2
+        assert h["sum"] == pytest.approx(10.5)
+        assert h["bucket_counts"] == [0, 1, 1]
+
+        merged = merge_snapshots([cut, delta])
+        assert _series(merged, "n_total", k="a")["value"] == 7
+        mh = _series(merged, "t_seconds")
+        assert mh["count"] == 3
+        assert mh["sum"] == pytest.approx(11.0)
+        assert mh["bucket_counts"] == [1, 1, 1]
+
+    def test_diff_drops_untouched_series_and_keeps_gauges(self):
+        registry = Registry()
+        registry.counter("quiet_total").inc(3)
+        registry.gauge("g").set(1)
+        cut = registry.snapshot()
+        registry.gauge("g").set(42)
+        delta = diff_snapshots(cut, registry.snapshot())
+        assert "quiet_total" not in delta["families"]
+        assert _series(delta, "g")["value"] == 42
+
+    def test_merge_gauges_last_write_wins(self):
+        a = Registry()
+        a.gauge("g").set(1)
+        b = Registry()
+        b.gauge("g").set(7)
+        merged = merge_snapshots([a.snapshot(), b.snapshot()])
+        assert _series(merged, "g")["value"] == 7
+
+
+def test_global_singletons_are_stable():
+    assert get_registry() is get_registry()
+    assert get_span_tracer() is get_span_tracer()

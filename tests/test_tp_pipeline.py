@@ -62,8 +62,20 @@ TP_RTOL = 1e-4
 
 
 def _assert_artifacts_equal(run_a: pathlib.Path, run_b: pathlib.Path) -> None:
+    from consensus_tpu.utils.diff import statement_parity_report
+
     a = pd.read_csv(run_a / "results.csv")
     b = pd.read_csv(run_b / "results.csv")
+    # Statement parity first, at token granularity: a reduction-order flake
+    # flips ONE greedy argmax at ONE position, and this names it (row,
+    # token index, both tokens) instead of dumping both frames.
+    parity = statement_parity_report(
+        a["statement"].fillna("").tolist(),
+        b["statement"].fillna("").tolist(),
+        run_a.name,
+        run_b.name,
+    )
+    assert parity is None, parity
     pd.testing.assert_frame_equal(
         a.drop(columns=["generation_time_s"]),
         b.drop(columns=["generation_time_s"]),
