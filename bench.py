@@ -170,6 +170,52 @@ def main() -> None:
     assert isinstance(lookahead_statement, str)
     lookahead_sps = 1.0 / lookahead_elapsed
 
+    # ---- wave-parallel MCTS (de-RTT'd slowest decoder) ---------------
+    # Reference-default search scale (num_simulations=50, width=5,
+    # rollout_depth=10) with pin_budget so every simulation issues real
+    # device work — the same workload the >=4x dispatch-reduction
+    # acceptance test pins on the fake backend (tests/test_mcts_wave.py).
+    # BENCH_MCTS=0 skips; BENCH_MCTS_WAVE / BENCH_MCTS_SIMS rescale.
+    mcts_extra = {}
+    if os.environ.get("BENCH_MCTS", "1") != "0":
+        mcts_wave = int(os.environ.get("BENCH_MCTS_WAVE", "8"))
+        mcts_sims = int(os.environ.get("BENCH_MCTS_SIMS", "50"))
+
+        def one_mcts(seed: int):
+            generator = get_method_generator(
+                "mcts",
+                backend,
+                {
+                    "num_simulations": mcts_sims,
+                    "expansion_sample_width": 5,
+                    "max_tokens": NEW_TOKENS,
+                    "rollout_depth": 10,
+                    "seed": seed,
+                    "pin_budget": True,
+                    "mcts_wave_size": mcts_wave,
+                },
+            )
+            statement = generator.generate_statement(issue, opinions)
+            assert isinstance(statement, str)
+            return generator
+
+        one_mcts(31)  # warmup / compile (wave-width padded shapes)
+        start = time.perf_counter()
+        mcts_gen = one_mcts(32)
+        mcts_elapsed = time.perf_counter() - start
+        stats = mcts_gen.search_stats
+        mcts_steps = max(1, len(stats["visit_log"]))
+        mcts_extra = {
+            "mcts_seconds_per_statement": round(mcts_elapsed, 2),
+            "mcts_device_dispatches_per_statement": stats["device_dispatches"],
+            "mcts_device_dispatches_per_token": round(
+                stats["device_dispatches"] / mcts_steps, 1
+            ),
+            "mcts_wave_size": mcts_wave,
+            "mcts_num_simulations": mcts_sims,
+            "mcts_virtual_loss_collisions": stats["collisions"],
+        }
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -273,6 +319,7 @@ def main() -> None:
                     "finite_lookahead_vs_baseline": round(
                         lookahead_sps / BASELINE_LOOKAHEAD_STATEMENTS_PER_SEC, 2
                     ),
+                    **mcts_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

@@ -97,16 +97,31 @@ class BatchingBackend:
             "flush_ms quiescence timeout.",
             labels=("kind", "reason"),
         )
+        self._spurious_wakeups = reg.counter(
+            "batching_spurious_wakeups_total",
+            "Mid-flush waiters woken while their own request was still "
+            "pending.  Stays 0 when completion wakeups are routed per kind; "
+            "a cross-kind broadcast would charge every parked waiter one "
+            "wakeup per other kind's dispatch.",
+            labels=("kind",),
+        )
         #: Until this many sessions have STARTED, the all-blocked heuristic
         #: is suppressed — otherwise the first worker to enqueue during pool
         #: ramp-up sees active==1 and flushes a batch of one.
         self.expected_sessions = max(1, expected_sessions)
-        self._cond = threading.Condition()
+        #: One lock guards all queues/flags; each kind waits on its OWN
+        #: condition over that lock, so a completed generate batch can wake
+        #: generate's waiters without stampeding score/next_token waiters
+        #: parked through the same flush.
+        self._lock = threading.Lock()
         self._active = 0
         self._started = 0
         self._flushing = False
         self._queues: Dict[str, List[_Pending]] = {
             "generate": [], "score": [], "next_token": [], "embed": [],
+        }
+        self._conds: Dict[str, threading.Condition] = {
+            kind: threading.Condition(self._lock) for kind in self._queues
         }
         #: Device batches actually issued per kind — the measurable win:
         #: N concurrent runs << N× the solo batch count.
@@ -137,19 +152,26 @@ class BatchingBackend:
             )
         return maker(spec)
 
+    def _notify(self, kinds) -> None:
+        """Wake the given kinds' waiters.  Caller holds ``_lock`` (every
+        per-kind condition shares it)."""
+        for kind in kinds:
+            self._conds[kind].notify_all()
+
     @contextlib.contextmanager
     def session(self):
         """Register the calling thread as an active run for flush accounting."""
-        with self._cond:
+        with self._lock:
             self._active += 1
             self._started += 1
         try:
             yield self
         finally:
-            with self._cond:
+            with self._lock:
                 self._active -= 1
-                # A departing session may complete the "all blocked" condition.
-                self._cond.notify_all()
+                # A departing session may complete the "all blocked"
+                # condition for a waiter of ANY kind.
+                self._notify(self._queues)
 
     # -- protocol ----------------------------------------------------------
 
@@ -198,18 +220,26 @@ class BatchingBackend:
         if not requests:
             return fn(requests)
         entry = _Pending(requests)
-        with self._cond:
+        cond = self._conds[kind]
+        with cond:
             self._queues[kind].append(entry)
-            self._cond.notify_all()
+            # An append changes the pending count that feeds EVERY kind's
+            # all-blocked predicate, so it broadcasts across kinds.
+            self._notify(self._queues)
             while not entry.done:
                 if self._flushing:
                     # A device batch is executing with the lock released:
                     # this entry rides the NEXT flush, merged with everything
                     # else that arrives during the multi-second device call.
-                    # Untimed: flush end always notify_all()s under the lock
-                    # (including on abort — _flush's finally errors stranded
-                    # entries), so polling here would only burn host cycles.
-                    self._cond.wait()
+                    # Untimed: flush end wakes every kind with snapshot or
+                    # queued entries under the lock (including on abort —
+                    # _flush's finally errors stranded entries), so polling
+                    # here would only burn host cycles.  Completion wakeups
+                    # are per kind; waking here with the flush still running
+                    # and this entry still pending means a wakeup was wasted.
+                    cond.wait()
+                    if self._flushing and not entry.done:
+                        self._spurious_wakeups.labels(kind).inc()
                     continue
                 pending = sum(len(q) for q in self._queues.values())
                 ramped = self._started >= self.expected_sessions
@@ -217,7 +247,7 @@ class BatchingBackend:
                     # Every active session is blocked on a call: flush
                     # EVERYTHING — nobody is coming to widen any batch.
                     self._flush(tuple(self._queues), reason="all_blocked")
-                elif not self._cond.wait(timeout=self._window_s(kind)):
+                elif not cond.wait(timeout=self._window_s(kind)):
                     # Quiescent for a full window (appends notify): flush
                     # THIS kind only — other kinds run their own windows
                     # (a 10 ms score timeout must not fragment a generate
@@ -248,7 +278,7 @@ class BatchingBackend:
             for k in kinds:
                 snapshot[k] = self._queues[k]
                 self._queues[k] = []
-            self._cond.release()
+            self._lock.release()
             released = True
             self._run_batches(snapshot, reason)
         finally:
@@ -256,7 +286,7 @@ class BatchingBackend:
             # the snapshot/release lines must still clear _flushing (waiters
             # park in an untimed wait) and fail stranded entries.
             if released:
-                self._cond.acquire()
+                self._lock.acquire()
             self._flushing = False
             # A non-Exception abort (KeyboardInterrupt between per-kind
             # dispatches) can leave snapshotted entries undone AND already
@@ -271,7 +301,16 @@ class BatchingBackend:
                             "dispatched"
                         )
                         entry.done = True
-            self._cond.notify_all()
+            # Flush end wakes only kinds that can have a waiter parked or
+            # pending: snapshot kinds (their entries just completed — the
+            # happy path already woke them mid-flush, but the abort path
+            # above may have errored them here) and kinds whose queues
+            # refilled during the flush (those waiters sat out the untimed
+            # wait and must re-evaluate now that _flushing cleared).
+            self._notify(
+                {k for k, q in snapshot.items() if q}
+                | {k for k, q in self._queues.items() if q}
+            )
 
     def _run_batches(
         self, snapshot: Dict[str, List[_Pending]], reason: str
@@ -315,5 +354,11 @@ class BatchingBackend:
             # host-side work (parsing, prompt building) overlaps the
             # remaining kinds' device dispatches — mid-flush waiters park in
             # an untimed wait and would otherwise sleep out the whole flush.
-            with self._cond:
-                self._cond.notify_all()
+            # Only THIS kind's condition is notified: the other kinds'
+            # waiters have nothing new to learn until their own batch (or
+            # the flush end) completes, and waking them would just burn a
+            # scheduler round trip per parked thread (the spurious-wakeup
+            # counter pins this at zero).
+            cond = self._conds[kind]
+            with cond:
+                cond.notify_all()

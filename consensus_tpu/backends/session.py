@@ -74,6 +74,9 @@ class PrefixTokenSearchSession:
         self.spec = spec
         self._sequences = [""] * spec.n_slots
         self._step = 0
+        #: Backend protocol calls actually submitted (the fallback's unit of
+        #: host->device round trips).  Decoders read the delta per statement.
+        self.dispatch_count = 0
 
     # -- protocol ------------------------------------------------------------
 
@@ -125,16 +128,38 @@ class PrefixTokenSearchSession:
     ) -> Tuple[List[int], str, List[float], bool]:
         """Continue ``depth`` reference-policy tokens past trunk+suffix and
         return (rollout token ids, rollout text, per-agent total logprob of
-        the rollout tokens, ok).  Fallback: one generate call + one batched
-        score call."""
+        the rollout tokens, ok).  Delegates to :meth:`rollout_many` — one
+        generate call + one batched score call either way, so results are
+        bit-identical to the historical single-path implementation."""
+        return self.rollout_many([suffix], depth, [salt])[0]
+
+    def rollout_many(
+        self,
+        suffixes: Sequence[Sequence[ScoredCandidate]],
+        depth: int,
+        salts: Sequence[int],
+    ) -> List[Tuple[List[int], str, List[float], bool]]:
+        """Batched :meth:`rollout_from`: ONE generate call over all paths and
+        ONE score call over (path x agent).  Row i uses ``salts[i]`` in the
+        family-2 seed map, so each row's result is bit-identical to a
+        sequential ``rollout_from(suffixes[i], depth, salts[i])`` call."""
         from consensus_tpu.backends.base import GenerationRequest
 
         spec = self.spec
         if spec.n_slots != 1:
-            raise ValueError("rollout_from requires an n_slots=1 session")
-        prefix = self._sequences[0] + "".join(c.token for c in suffix)
+            raise ValueError("rollout_many requires an n_slots=1 session")
+        if len(salts) != len(suffixes):
+            raise ValueError(
+                f"expected {len(suffixes)} salts, got {len(salts)}"
+            )
+        if not suffixes:
+            return []
+        trunk = self._sequences[0]
+        prefixes = [
+            trunk + "".join(c.token for c in suffix) for suffix in suffixes
+        ]
         seed = spec.seed
-        result = self.backend.generate(
+        results = self.backend.generate(
             [
                 GenerationRequest(
                     user_prompt=spec.ref_user + prefix,
@@ -143,33 +168,51 @@ class PrefixTokenSearchSession:
                     temperature=spec.temperature,
                     # Family 2 = rollouts (0 = trunk steps, 1 = suffix
                     # proposals) in the injective (seed, family, index, row)
-                    # seed map of _proposals_for.
+                    # seed map of _proposals_for.  The salt is the row-unique
+                    # coordinate here, so batching preserves per-path streams.
                     seed=((seed * 3 + 2) * 1_000_000_000 + salt * 1000)
                     if seed is not None
                     else None,
                     chat=False,
                 )
-            ]
-        )[0]
-        if not result.ok:
-            return [], "", [], False
-        if not result.text:
-            return [], "", [0.0] * len(spec.agent_prompts), True
-        scores = self.backend.score(
-            [
-                ScoreRequest(
-                    context=a_user + prefix,
-                    continuation=result.text,
-                    system_prompt=a_system,
-                    chat=False,
-                )
-                for a_system, a_user in spec.agent_prompts
+                for prefix, salt in zip(prefixes, salts)
             ]
         )
-        totals = [
-            (sum(s.logprobs) if s.ok else spec.failure_logprob) for s in scores
-        ]
-        return list(result.token_ids), result.text, totals, True
+        self.dispatch_count += 1
+        n_agents = len(spec.agent_prompts)
+        score_requests: List[ScoreRequest] = []
+        starts: List[Optional[int]] = []
+        for prefix, result in zip(prefixes, results):
+            if result.ok and result.text:
+                starts.append(len(score_requests))
+                for a_system, a_user in spec.agent_prompts:
+                    score_requests.append(
+                        ScoreRequest(
+                            context=a_user + prefix,
+                            continuation=result.text,
+                            system_prompt=a_system,
+                            chat=False,
+                        )
+                    )
+            else:
+                starts.append(None)
+        scores = self.backend.score(score_requests) if score_requests else []
+        if score_requests:
+            self.dispatch_count += 1
+        out: List[Tuple[List[int], str, List[float], bool]] = []
+        for result, start in zip(results, starts):
+            if not result.ok:
+                out.append(([], "", [], False))
+            elif not result.text:
+                out.append(([], "", [0.0] * n_agents, True))
+            else:
+                row = scores[start : start + n_agents]
+                totals = [
+                    (sum(s.logprobs) if s.ok else spec.failure_logprob)
+                    for s in row
+                ]
+                out.append((list(result.token_ids), result.text, totals, True))
+        return out
 
     # -- internals -----------------------------------------------------------
 
@@ -206,6 +249,7 @@ class PrefixTokenSearchSession:
             for row, prefix in enumerate(prefixes)
         ]
         proposals = self.backend.next_token_logprobs(requests)
+        self.dispatch_count += 1
 
         score_requests = []
         for prefix, candidates in zip(prefixes, proposals):
@@ -220,6 +264,8 @@ class PrefixTokenSearchSession:
                         )
                     )
         scores = self.backend.score(score_requests)
+        if score_requests:
+            self.dispatch_count += 1
         return self._zip_scores(proposals, scores)
 
     def _propose_and_score(self) -> List[List[ScoredCandidate]]:

@@ -1467,6 +1467,10 @@ class TPUTokenSearchSession:
         self._budget_bytes = cache_bytes
         self._step = 0
         self._state = None
+        #: Fused device programs launched by this session (each one is one
+        #: host->device round trip over the tunneled relay).  Decoders read
+        #: the delta per statement for the obs dispatch counters.
+        self.dispatch_count = 0
         bias = backend._bias_vector(spec.bias_against_tokens, spec.bias_value)
         self._ref_bias = jnp.asarray(bias) if bias is not None else None
         # One base key per session; per-(step, slot) keys fold in-device so a
@@ -1494,6 +1498,7 @@ class TPUTokenSearchSession:
         self.backend.token_counts["scored"] += (
             spec.n_slots * spec.k * (self.n_roles - 1)
         )
+        self.dispatch_count += 1
         out = search_prefill(
             self.backend.params, self.backend.config,
             self._tokens, self._valid,
@@ -1533,6 +1538,7 @@ class TPUTokenSearchSession:
             ]
         )
         step_meta = np.asarray([self._step, self._step - 1], np.int32)
+        self.dispatch_count += 1
         out = search_step(
             self.backend.params, self.backend.config,
             self._state,
@@ -1551,8 +1557,6 @@ class TPUTokenSearchSession:
         candidates hanging off the trunk), sharing the trunk cache across
         all paths (models/stepper.py:suffix_propose).  Trunk sessions only
         (n_slots == 1); the trunk itself advances via advance_and_propose."""
-        from consensus_tpu.models.stepper import suffix_propose
-
         self._check_open()
         spec = self.spec
         if spec.n_slots != 1:
@@ -1561,9 +1565,37 @@ class TPUTokenSearchSession:
             raise ValueError("call propose() before propose_suffixes()")
         if not suffixes:
             return []
-        span = len(suffixes[0])
-        if any(len(s) != span for s in suffixes) or span == 0:
-            raise ValueError("suffixes must share one non-zero length")
+        if any(len(s) == 0 for s in suffixes):
+            raise ValueError("suffixes must be non-empty")
+        # The fused kernel wants one uniform suffix length per call (the
+        # shared-prefill shapes are static) — mixed-length callers (wave
+        # MCTS selects leaves at different depths) are grouped by span,
+        # one device call per distinct span, results re-ordered.
+        groups: Dict[int, List[int]] = {}
+        for i, suffix in enumerate(suffixes):
+            groups.setdefault(len(suffix), []).append(i)
+        multi = len(groups) > 1
+        results: List[Optional[List["ScoredCandidate"]]] = [None] * len(suffixes)
+        for span, idxs in groups.items():
+            # Single-span calls keep the caller's salt verbatim (the only
+            # historically legal shape — existing PRNG streams must not
+            # move).  With several spans, each group folds its span into
+            # the salt so no two groups replay identical per-row keys.
+            group_salt = (salt ^ (span << 20)) if multi else salt
+            rows = self._propose_suffix_group(
+                [suffixes[i] for i in idxs], span, group_salt
+            )
+            for i, row in zip(idxs, rows):
+                results[i] = row
+        return results
+
+    def _propose_suffix_group(
+        self, suffixes: Sequence[Sequence], span: int, salt: int
+    ) -> List[List["ScoredCandidate"]]:
+        """One fused suffix_propose call over equal-length suffixes."""
+        from consensus_tpu.models.stepper import suffix_propose
+
+        spec = self.spec
         # Pad the path count to a bucket (repeating row 0) so XLA reuses a
         # small set of compiled (P, L) shapes across tree levels.
         # Each path re-evaluates its span under every agent and proposes k
@@ -1577,6 +1609,7 @@ class TPUTokenSearchSession:
             tokens[i] = [c.token_id for c in suffix]
         tokens[len(suffixes):] = tokens[0]
 
+        self.dispatch_count += 1
         packed = np.asarray(
             suffix_propose(
                 self.backend.params, self.backend.config,
@@ -1608,6 +1641,7 @@ class TPUTokenSearchSession:
             raise ValueError("call propose() before rollout_from()")
         if not suffix:
             raise ValueError("rollout_from needs a non-empty suffix")
+        self.dispatch_count += 1
         rows = np.asarray(
             rollout_scored(
                 self.backend.params, self.backend.config,
@@ -1619,6 +1653,98 @@ class TPUTokenSearchSession:
                 jnp.asarray(self.backend.tokenizer.eos_ids, jnp.int32),
             )
         )  # (depth, 2 + A)
+        return self._rollout_result(rows, depth)
+
+    def rollout_many(
+        self, suffixes: Sequence[Sequence], depth: int, salts: Sequence[int]
+    ) -> List[Tuple[List[int], str, List[float], bool]]:
+        """Batched :meth:`rollout_from` over a wave of tree paths.  Paths
+        are grouped by suffix length (the fused kernel's shared-prefill
+        shapes are static per span); a singleton group delegates to
+        ``rollout_from`` — bit-identical to the sequential path — while a
+        multi-path group runs ONE ``rollout_scored_many`` program per HBM
+        chunk (the wave width is capped by :meth:`_rollout_chunk_cap` so
+        the per-(path x role) decode tails stay inside the session's
+        reservation slack)."""
+        from consensus_tpu.models.stepper import rollout_scored_many
+
+        self._check_open()
+        spec = self.spec
+        if spec.n_slots != 1:
+            raise ValueError("rollout_many requires an n_slots=1 session")
+        if self._state is None:
+            raise ValueError("call propose() before rollout_many()")
+        if len(salts) != len(suffixes):
+            raise ValueError(
+                f"expected {len(suffixes)} salts, got {len(salts)}"
+            )
+        if not suffixes:
+            return []
+        if any(not s for s in suffixes):
+            raise ValueError("rollout_many needs non-empty suffixes")
+        groups: Dict[int, List[int]] = {}
+        for i, suffix in enumerate(suffixes):
+            groups.setdefault(len(suffix), []).append(i)
+        results: List[Optional[Tuple[List[int], str, List[float], bool]]] = (
+            [None] * len(suffixes)
+        )
+        for span, idxs in groups.items():
+            cap = self._rollout_chunk_cap(span, depth)
+            for lo in range(0, len(idxs), cap):
+                chunk = idxs[lo : lo + cap]
+                if len(chunk) == 1:
+                    i = chunk[0]
+                    results[i] = self.rollout_from(
+                        suffixes[i], depth, salts[i]
+                    )
+                    continue
+                # Bucket the path count (padding repeats row 0 with its own
+                # salt — identical compute, sliced away) for shape reuse.
+                n_paths = _bucket(len(chunk), minimum=2)
+                tokens = np.zeros((n_paths, span), np.int32)
+                salt_arr = np.zeros((n_paths,), np.int32)
+                for j, i in enumerate(chunk):
+                    tokens[j] = [c.token_id for c in suffixes[i]]
+                    salt_arr[j] = salts[i]
+                tokens[len(chunk):] = tokens[0]
+                salt_arr[len(chunk):] = salt_arr[0]
+                self.dispatch_count += 1
+                rows = np.asarray(
+                    rollout_scored_many(
+                        self.backend.params, self.backend.config,
+                        self._state, jnp.asarray(self._step, jnp.int32),
+                        jnp.asarray(tokens), jnp.asarray(salt_arr),
+                        self.n_roles, span, depth,
+                        self._base_key, self._temperature,
+                        jnp.asarray(
+                            self.backend.tokenizer.eos_ids, jnp.int32
+                        ),
+                    )
+                )  # (n_paths, depth, 2 + A)
+                for j, i in enumerate(chunk):
+                    results[i] = self._rollout_result(rows[j], depth)
+        return results
+
+    def _rollout_chunk_cap(self, span: int, depth: int) -> int:
+        """How many wave paths one rollout_scored_many call may carry: each
+        path adds a (n_layers x n_roles x (span + depth)) decode tail on
+        top of the scratch trunk copy, and the session's 2x reservation
+        (constructor) only pre-books the scratch — cap the tails at 1/8 of
+        the reservation so a wide wave degrades into chunks instead of
+        blowing the budget."""
+        c = self.backend.config
+        itemsize = jnp.dtype(self.backend.params["embed"].dtype).itemsize
+        per_path = (
+            2 * c.n_layers * self.n_roles * (span + depth)
+            * c.n_kv_heads * c.head_dim * itemsize
+        ) // self.backend._shard_count
+        allowance = self._budget_bytes // 8
+        return max(1, int(allowance // max(per_path, 1)))
+
+    def _rollout_result(
+        self, rows: np.ndarray, depth: int
+    ) -> Tuple[List[int], str, List[float], bool]:
+        """Unpack one path's (depth, 2 + A) rollout rows + token accounting."""
         counted = rows[:, 1] > 0.5
         tok = self.backend.tokenizer
         ids = [int(rows[t, 0]) for t in range(depth) if counted[t]]

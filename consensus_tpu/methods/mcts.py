@@ -25,17 +25,38 @@ and aborts every MCTS run; this implementation evaluates rollouts correctly.
 Cost redesign: the whole statement drives ONE trunk session
 (backends/session.py).  Each expansion is a single propose_suffixes call —
 the k proposals AND their per-agent scores come out of one forward over the
-shared trunk cache — and each rollout+evaluation is a single rollout_scored
-call (sample ``rollout_depth`` tokens, score every one under every agent
-from the same logits).  The rolled-out statement's total agent logprob
-telescopes as trunk-sum + node-path-sum + rollout-sum by the chain rule,
-replacing the reference's full-statement re-scoring.
+shared trunk cache — and each rollout+evaluation is a single scored-rollout
+call.  The rolled-out statement's total agent logprob telescopes as
+trunk-sum + node-path-sum + rollout-sum by the chain rule, replacing the
+reference's full-statement re-scoring.
+
+Wave search (``mcts_wave_size``): simulations run in WAVES of K leaf
+selections under UCB1 with *virtual loss* — each selection transiently
+counts an extra visit whose reward sits ``virtual_loss`` below the node's
+current mean, so subsequent selections in the same wave diverge — then ALL
+expansion proposals ride ONE batched ``propose_suffixes`` call and ALL fresh
+rollouts ONE batched ``rollout_many`` call, the virtual losses are reverted
+exactly, and every reward backpropagates in selection order.  The virtual
+loss is mean-relative (not an absolute loss value) because token-MDP rewards
+are unbounded log-probabilities: subtracting a fixed penalty from the mean
+discourages re-selection at any reward scale.  ``mcts_wave_size=1``
+reproduces the sequential search bit-for-bit (same session calls, same salt
+sequence — golden-pinned in tests/test_token_decoders.py); sweep configs set
+8 to cut host↔device round trips per statement by ~an order of magnitude
+(the obs counters below measure it).
+
+Observability (docs/ARCHITECTURE.md §Observability): per-backend counters
+``mcts_device_dispatches_total`` / ``mcts_statements_total`` (dispatches per
+statement = the de-RTT headline), ``mcts_wave_selections_total``, the
+``mcts_wave_width`` histogram, and ``mcts_virtual_loss_collisions_total``
+(duplicate-leaf selections that produced no fresh child — the price of
+batching selections before their rewards land).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from consensus_tpu.backends.session import (
     ScoredCandidate,
@@ -46,6 +67,7 @@ from consensus_tpu.methods.base import BaseGenerator
 from consensus_tpu.methods.beam_search import BIAS_AGAINST_TOKENS, EOS_TOKENS
 from consensus_tpu.methods.brushup import brushup_statement_ending
 from consensus_tpu.methods.prompts import agent_prompt, reference_prompt
+from consensus_tpu.obs import DEFAULT_COUNT_BUCKETS, get_registry
 
 FAILURE_REWARD = -100.0
 
@@ -110,6 +132,8 @@ class MCTSGenerator(BaseGenerator):
         )
         self._rollout_depth = int(cfg.get("rollout_depth", 10))
         self._gamma = float(cfg.get("gamma", 0.99))
+        self._wave_size = max(1, int(cfg.get("mcts_wave_size", 1)))
+        self._virtual_loss = float(cfg.get("virtual_loss", 1.0))
         temperature = float(cfg.get("temperature", 1.0))
 
         agents = list(agent_opinions.items())
@@ -139,10 +163,55 @@ class MCTSGenerator(BaseGenerator):
         )
         self._salt = 0
 
+        registry = get_registry()
+        label = getattr(self.backend, "name", "unknown")
+        self._obs_wave_width = registry.histogram(
+            "mcts_wave_width",
+            "Realized MCTS wave widths (leaf selections per wave)",
+            ("backend",),
+            DEFAULT_COUNT_BUCKETS,
+        ).labels(label)
+        self._obs_selections = registry.counter(
+            "mcts_wave_selections_total",
+            "MCTS leaf selections across all waves",
+            ("backend",),
+        ).labels(label)
+        self._obs_collisions = registry.counter(
+            "mcts_virtual_loss_collisions_total",
+            "Duplicate-leaf wave selections that yielded no fresh child",
+            ("backend",),
+        ).labels(label)
+        obs_dispatches = registry.counter(
+            "mcts_device_dispatches_total",
+            "Session device dispatches issued by MCTS statements",
+            ("backend",),
+        ).labels(label)
+        obs_statements = registry.counter(
+            "mcts_statements_total",
+            "MCTS statements generated",
+            ("backend",),
+        ).labels(label)
+        #: Per-statement stats surfaced for tests and bench.py.
+        self.search_stats: Dict[str, object] = {
+            "device_dispatches": 0,
+            "waves": 0,
+            "selections": 0,
+            "collisions": 0,
+            "wave_size": self._wave_size,
+            "visit_log": [],
+        }
+
+        dispatches_before = getattr(self._session, "dispatch_count", 0)
         try:
             statement = self._search(max_tokens)
         finally:
+            dispatches = (
+                getattr(self._session, "dispatch_count", 0) - dispatches_before
+            )
             self._session.close()
+        self.search_stats["device_dispatches"] = dispatches
+        obs_dispatches.inc(dispatches)
+        obs_statements.inc()
         self.pre_brushup_statement = statement
         if cfg.get("brushup", False):
             statement = brushup_statement_ending(
@@ -159,18 +228,18 @@ class MCTSGenerator(BaseGenerator):
         root.untried = list(self._session.propose()[0])
 
         for step in range(max_tokens):
-            for _sim in range(self._num_simulations):
-                leaf = self._select(root)
-                if leaf.is_terminal:
-                    reward, target = leaf.immediate_reward, leaf
-                else:
-                    child = self._expand_and_evaluate(leaf, trunk_sums)
-                    if child is None:  # fully expanded with zero candidates
-                        reward, target = leaf.immediate_reward, leaf
-                    else:
-                        reward, target = child.immediate_reward, child
-                self._backpropagate(target, reward)
+            sims_done = 0
+            while sims_done < self._num_simulations:
+                width = min(self._wave_size, self._num_simulations - sims_done)
+                self._run_wave(root, width, trunk_sums)
+                sims_done += width
 
+            self.search_stats["visit_log"].append(
+                sorted(
+                    (ch.cand.token, ch.visits)
+                    for ch in root.children.values()
+                )
+            )
             best = self._most_visited_child(root)
             if best is None:
                 break
@@ -194,6 +263,133 @@ class MCTSGenerator(BaseGenerator):
 
     # -- phases --------------------------------------------------------------
 
+    def _run_wave(
+        self, root: Node, width: int, trunk_sums: List[float]
+    ) -> None:
+        """One wave = ``width`` simulations sharing two batched device calls.
+
+        Select ``width`` leaves under UCB1, applying a virtual loss along
+        each selected path so later selections diverge; batch every
+        never-expanded leaf into ONE ``propose_suffixes`` call and every
+        fresh non-terminal child into ONE ``rollout_many`` call; revert the
+        virtual losses exactly; backpropagate all rewards in selection
+        order.  ``width == 1`` degenerates to the pre-wave sequential
+        search: one selection, at most one singleton proposal call and one
+        singleton rollout (same salt sequence), zero net virtual loss.
+        """
+        selections: List[Node] = []
+        #: (node, pre-application total_reward) in application order — the
+        #: revert restores saved totals in REVERSE, so it is exact even
+        #: where float add/subtract would not round-trip.
+        vl_records: List[Tuple[Node, float]] = []
+        for _ in range(width):
+            leaf = self._select(root)
+            selections.append(leaf)
+            if width == 1:
+                continue  # nothing to diverge from — keep stats untouched
+            # Virtual loss: count one transient visit at (mean - penalty)
+            # along the whole path.  Mean-relative, so it biases selection
+            # away regardless of the (unbounded) reward scale.
+            node: Optional[Node] = leaf
+            while node is not None:
+                vl_records.append((node, node.total_reward))
+                node.total_reward += node.value - self._virtual_loss
+                node.visits += 1
+                node = node.parent
+        self._obs_wave_width.observe(width)
+        self._obs_selections.inc(width)
+
+        # ONE batched proposal call for all never-expanded selected leaves.
+        need: List[Node] = []
+        need_ids = set()
+        for leaf in selections:
+            if (
+                not leaf.is_terminal
+                and leaf.untried is None
+                and id(leaf) not in need_ids
+            ):
+                need_ids.add(id(leaf))
+                need.append(leaf)
+        if need:
+            self._salt += 1
+            proposals = self._session.propose_suffixes(
+                [leaf.suffix() for leaf in need], self._salt
+            )
+            for leaf, props in zip(need, proposals):
+                leaf.untried = list(props)
+
+        # Resolve each selection to its backprop target.  Fresh
+        # non-terminal children queue for the batched rollout; a duplicate
+        # selection that finds its leaf terminal/exhausted is a virtual-loss
+        # collision (the wave spent a simulation re-proving a dead end).
+        resolved: List[Tuple[Node, Optional[float]]] = []
+        pending: List[Tuple[Node, float]] = []
+        leaf_seen = set()
+        collisions = 0
+        for leaf in selections:
+            duplicate = id(leaf) in leaf_seen
+            leaf_seen.add(id(leaf))
+            if leaf.is_terminal or not leaf.untried:
+                if duplicate:
+                    collisions += 1
+                resolved.append((leaf, leaf.immediate_reward))
+                continue
+            candidate = leaf.untried.pop(0)
+            child = Node(candidate, leaf, self._eos_tokens)
+            leaf.children[candidate.token] = child
+            # Egalitarian immediate reward: min over agents of the new
+            # token's logprob — delivered by the proposal itself
+            # (reference :249-329).
+            immediate = min(candidate.agent_logprobs)
+            if child.is_terminal:
+                child.immediate_reward = immediate
+                resolved.append((child, immediate))
+            else:
+                pending.append((child, immediate))
+                resolved.append((child, None))
+
+        # ONE batched rollout call for all fresh non-terminal children.
+        # Min over agents of the rolled-out statement's TOTAL logprob
+        # (reference :470-651): trunk + node path + rollout sums telescope.
+        if pending:
+            salts = []
+            for _ in pending:
+                self._salt += 1
+                salts.append(self._salt)
+            rollouts = self._session.rollout_many(
+                [child.suffix() for child, _ in pending],
+                self._rollout_depth,
+                salts,
+            )
+            for (child, immediate), (_ids, _text, rollout_sums, ok) in zip(
+                pending, rollouts
+            ):
+                if not ok:
+                    rollout_value = FAILURE_REWARD
+                else:
+                    path_sums = child.path_agent_sums(self._n_agents)
+                    totals = [
+                        t + p + r
+                        for t, p, r in zip(
+                            trunk_sums, path_sums, rollout_sums
+                        )
+                    ]
+                    rollout_value = min(totals) if totals else FAILURE_REWARD
+                child.immediate_reward = immediate + self._gamma * rollout_value
+
+        for node, saved_total in reversed(vl_records):
+            node.visits -= 1
+            node.total_reward = saved_total
+        for target, reward in resolved:
+            if reward is None:
+                reward = target.immediate_reward
+            self._backpropagate(target, reward)
+        if collisions:
+            self._obs_collisions.inc(collisions)
+        self.search_stats["waves"] += 1
+        self.search_stats["selections"] += width
+        self.search_stats["collisions"] += collisions
+
     def _select(self, node: Node) -> Node:
         """UCB1 walk until a node with unexpanded candidates or a terminal."""
         while not node.is_terminal:
@@ -211,45 +407,6 @@ class MCTSGenerator(BaseGenerator):
                 ),
             )
         return node
-
-    def _expand_and_evaluate(
-        self, node: Node, trunk_sums: List[float]
-    ) -> Optional[Node]:
-        if node.untried is None:
-            self._salt += 1
-            node.untried = list(
-                self._session.propose_suffixes([node.suffix()], self._salt)[0]
-            )
-        if not node.untried:
-            return None
-        candidate = node.untried.pop(0)
-        child = Node(candidate, node, self._eos_tokens)
-        node.children[candidate.token] = child
-
-        # Egalitarian immediate reward: min over agents of the new token's
-        # logprob — delivered by the proposal itself (reference :249-329).
-        immediate = min(candidate.agent_logprobs)
-        if child.is_terminal:
-            child.immediate_reward = immediate
-        else:
-            rollout_value = self._rollout_value(child, trunk_sums)
-            child.immediate_reward = immediate + self._gamma * rollout_value
-        return child
-
-    def _rollout_value(self, child: Node, trunk_sums: List[float]) -> float:
-        """Min over agents of the rolled-out statement's TOTAL logprob
-        (reference :470-651): trunk + node path + rollout sums telescope."""
-        self._salt += 1
-        _ids, _text, rollout_sums, ok = self._session.rollout_from(
-            child.suffix(), self._rollout_depth, self._salt
-        )
-        if not ok:
-            return FAILURE_REWARD
-        path_sums = child.path_agent_sums(self._n_agents)
-        totals = [
-            t + p + r for t, p, r in zip(trunk_sums, path_sums, rollout_sums)
-        ]
-        return min(totals) if totals else FAILURE_REWARD
 
     @staticmethod
     def _backpropagate(node: Optional[Node], reward: float) -> None:

@@ -387,3 +387,129 @@ def rollout_scored(
     )
     _, rows = jax.lax.scan(step, init, jnp.arange(depth))
     return rows  # (depth, 2 + A)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "n_roles", "suffix_len", "depth"),
+)
+def rollout_scored_many(
+    params,
+    config: ModelConfig,
+    state: SearchState,  # n_slots=1 trunk session (NOT consumed)
+    t_filled: jax.Array,  # () int32
+    suffix_tokens: jax.Array,  # (P, suffix_len) int32 — one row per path
+    salts: jax.Array,  # (P,) int32 — one rollout PRNG salt per path
+    n_roles: int,
+    suffix_len: int,
+    depth: int,
+    base_key: jax.Array,  # (2,)
+    temperature: jax.Array,
+    eos_ids: jax.Array,  # (E,) int32
+) -> jax.Array:
+    """A whole WAVE of MCTS rollouts in ONE device call: ``P`` equal-length
+    tree paths each continue ``depth`` reference-policy tokens past
+    trunk+tail+suffix, scoring every sampled token under every agent from
+    the same logits.  Returns packed (P, depth, 2 + A) f32 rows
+    [token_id, counted, agent_logprobs...] per path.
+
+    Data flow: the suffixes prefill over the SHARED scratch trunk in one
+    ``forward_shared_trunk`` pass whose per-layer roped keys/values seed
+    per-(path x role) decode tails (width suffix_len + depth); the rollout
+    loop then runs ``forward_trunk_tail`` with n_slots=P — the trunk stays
+    one copy per role, so per-path HBM is just the narrow tail.  Per-path
+    keys fold (family 2, salts[p]), making path p's token stream identical
+    to a singleton ``rollout_scored`` call with the same salt modulo
+    post-EOS cache writes (rollout_scored stops writing after EOS; here
+    done paths keep writing uncounted tokens that only their own uncounted
+    steps ever attend).  The einsum attention path is forced because the
+    scratch trunk has interior invalid columns (see forward_trunk_tail).
+    """
+    c = config
+    n_paths = suffix_tokens.shape[0]
+    rows = n_paths * n_roles
+    scratch, _ = _scratch_cache(state, t_filled, extra=0)
+    hidden, suf_k, suf_v = forward_shared_trunk(
+        params, config, suffix_tokens, scratch, state.cur_pos,
+        return_suffix_kv=True,
+    )  # hidden (P, R, D); suf_k/v (L, P, R, suffix_len, KV, hd)
+
+    pad = ((0, 0), (0, 0), (0, depth), (0, 0), (0, 0))
+    tail_k = jnp.pad(
+        suf_k.reshape(c.n_layers, rows, suffix_len, c.n_kv_heads, c.head_dim),
+        pad,
+    )
+    tail_v = jnp.pad(
+        suf_v.reshape(c.n_layers, rows, suffix_len, c.n_kv_heads, c.head_dim),
+        pad,
+    )
+    suffix_pos = state.cur_pos[:, None] + 1 + jnp.arange(suffix_len)[None, :]
+    tail_positions = jnp.pad(
+        jnp.tile(suffix_pos, (n_paths, 1)), ((0, 0), (0, depth))
+    )  # (rows, suffix_len + depth)
+    pos0 = jnp.tile(state.cur_pos, (n_paths,)) + suffix_len  # last written
+    rollout_keys = jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.fold_in(base_key, 2), s)
+    )(salts)  # (P, 2)
+
+    def step(carry, t):
+        hidden_last, k_tail, v_tail, kp_tail, pos, done = carry
+        logits = project_logits(params, config, hidden_last)  # (rows, V) f32
+        lp = jax.nn.log_softmax(
+            logits.reshape(n_paths, n_roles, -1).astype(jnp.float32), axis=-1
+        )
+        keys = jax.vmap(lambda kk: jax.random.fold_in(kk, t))(rollout_keys)
+        ref_lp = lp[:, 0, :]
+        sampled = jax.vmap(jax.random.categorical)(
+            keys, ref_lp / jnp.maximum(temperature, 1e-6)
+        )
+        token = jnp.where(
+            temperature <= 0.0, jnp.argmax(ref_lp, axis=-1), sampled
+        ).astype(jnp.int32)  # (P,)
+        is_eos = (
+            jnp.any(token[:, None] == eos_ids[None, :], axis=-1)
+            if eos_ids.shape[0]
+            else jnp.zeros((n_paths,), bool)
+        )
+        counted = ~done & ~is_eos  # (P,)
+        agent_lps = jnp.take_along_axis(
+            lp[:, 1:, :],
+            jnp.broadcast_to(
+                token[:, None, None], (n_paths, n_roles - 1, 1)
+            ),
+            axis=-1,
+        )[..., 0]  # (P, A)
+        new_done = done | is_eos
+
+        pos = pos + 1
+        write_col = suffix_len + t
+        kp_tail = jax.lax.dynamic_update_slice(
+            kp_tail, pos[:, None], (0, write_col)
+        )
+        row_tokens = jnp.repeat(token, n_roles)  # path-major (rows,)
+        hidden2, k_tail, v_tail = forward_trunk_tail(
+            params, config, row_tokens, pos,
+            scratch, k_tail, v_tail, kp_tail, write_col,
+            n_paths, n_roles,
+            use_decode_kernel=False,
+        )
+        out = jnp.concatenate(
+            [
+                token.astype(jnp.float32)[:, None],
+                counted.astype(jnp.float32)[:, None],
+                jnp.where(counted[:, None], agent_lps, 0.0),
+            ],
+            axis=1,
+        )  # (P, 2 + A)
+        return (hidden2, k_tail, v_tail, kp_tail, pos, new_done), out
+
+    init = (
+        hidden.reshape(rows, -1),
+        tail_k,
+        tail_v,
+        tail_positions,
+        pos0,
+        jnp.zeros((n_paths,), bool),
+    )
+    _, out_rows = jax.lax.scan(step, init, jnp.arange(depth))
+    return jnp.moveaxis(out_rows, 0, 1)  # (P, depth, 2 + A)

@@ -416,6 +416,7 @@ def forward_trunk_tail(
     frozen_k=(),  # sequence of (L, Rows, F_i, KV, hd) blocks / (int8, scale)
     frozen_v=(),
     frozen_positions=(),  # sequence of (Rows, F_i) int32, one per block
+    use_decode_kernel: bool = True,
 ):
     """One-token decode step where every search slot shares ONE trunk cache.
 
@@ -447,6 +448,12 @@ def forward_trunk_tail(
     mirroring the weight path (quant.py MATMUL_LOWERING="astype").  A
     quantized tail is written quantized (one absmax round per step) so
     freezing a segment is a free list append.
+
+    ``use_decode_kernel=False`` forces the einsum path: the pallas kernel's
+    masking model assumes the trunk block is valid on [start_r, W0), which a
+    SCRATCH trunk ([session trunk | session tail] with interior invalid
+    columns — stepper.rollout_scored_many) violates; the einsum path masks
+    by ``trunk.key_valid`` and handles any validity pattern.
 
     Returns (final-norm hidden (Rows, D), new tail_k, new tail_v) with the
     tail structure preserved.
@@ -534,6 +541,7 @@ def forward_trunk_tail(
 
         if (
             c.use_decode_attention
+            and use_decode_kernel
             and not frozen_k
             and not tail_quantized
             and not trunk_quantized
@@ -693,6 +701,7 @@ def forward_shared_trunk(
     cache: KVCache,  # R-row trunk cache (one row per role), read-only
     cur_pos: jax.Array,  # (R,) int32 — last written trunk position per role
     return_all_positions: bool = False,
+    return_suffix_kv: bool = False,
 ) -> jax.Array:
     """Forward P path suffixes over ONE shared R-row trunk cache.
 
@@ -706,6 +715,12 @@ def forward_shared_trunk(
     Returns final-norm hidden states of the LAST suffix position, (P, R, D).
     Replaces the per-node API walk of the reference's `_generate_tree_paths`
     (finite_lookahead.py:225-422) at zero cache duplication.
+
+    ``return_suffix_kv``: additionally return the per-layer ROPED suffix
+    keys and plain values, each (n_layers, P, R, L, KV, hd) — exactly the
+    entries a per-(path x role) tail cache would hold, so a batched rollout
+    (stepper.rollout_scored_many) can seed its decode tails from this one
+    shared prefill instead of re-running the suffixes row-replicated.
     """
     c = config
     n_paths, span = suffix_tokens.shape
@@ -794,15 +809,19 @@ def forward_shared_trunk(
         ffn = matmul(gate * matmul(ffn_in, lp["w_up"]), lp["w_down"])
         if c.use_post_norms:
             ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
-        return x + ffn, None
+        return x + ffn, ((ks, vs) if return_suffix_kv else None)
 
-    x, _ = jax.lax.scan(
+    x, suffix_kv = jax.lax.scan(
         layer_step, x, (params["layers"], cache.k, cache.v, local_flags)
     )
     x = rms_norm(x, params["final_norm"], c.rms_eps, c.rmsnorm_style)
     if return_all_positions:
-        return x  # (P, R, L, D) — the shared-context scorer needs every slot
-    return x[:, :, -1, :]  # (P, R, D)
+        out = x  # (P, R, L, D) — the shared-context scorer needs every slot
+    else:
+        out = x[:, :, -1, :]  # (P, R, D)
+    if return_suffix_kv:
+        return out, suffix_kv[0], suffix_kv[1]
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -15,7 +15,10 @@ Writes ``reports/greedy_batch_invariance.md`` + ``.json``.
 
 Usage: PYTHONPATH=/root/.axon_site:/root/repo \
            python scripts/greedy_batch_invariance_check.py
-       [--quick]   (--quick: tiny model, CPU-ok)
+       [--quick]          (--quick: tiny model, CPU-ok)
+       [--backend fake]   (no model at all: deterministic fake backend —
+                           exercises the harness end-to-end and pins the
+                           fake's own composition invariance; jax-free)
 """
 
 from __future__ import annotations
@@ -26,16 +29,16 @@ import pathlib
 from datetime import datetime
 
 from consensus_tpu.backends.base import GenerationRequest
-from consensus_tpu.backends.tpu import TPUBackend
 from consensus_tpu.data.aamas_scenarios import SCENARIOS
 
 
-def main() -> None:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--model", default="gemma2-2b")
-    parser.add_argument("--quick", action="store_true")
-    parser.add_argument("--max-tokens", type=int, default=256)
-    args = parser.parse_args()
+def build_backend(args):
+    """Returns (backend, model_label, dtype, quantization, max_tokens)."""
+    if args.backend == "fake":
+        from consensus_tpu.backends.fake import FakeBackend
+
+        return FakeBackend(), "fake", "none", None, min(args.max_tokens, 32)
+    from consensus_tpu.backends.tpu import TPUBackend
 
     if args.quick:
         import jax
@@ -56,6 +59,26 @@ def main() -> None:
         base_seed=0,
         use_flash_attention=not args.quick,
     )
+    return backend, model, dtype, quantization, max_tokens
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="gemma2-2b")
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--max-tokens", type=int, default=256)
+    parser.add_argument(
+        "--backend", choices=["tpu", "fake"], default="tpu",
+        help="'fake' runs the identical harness on the deterministic fake "
+        "backend (no jax, no weights) — CI-runnable end-to-end check.",
+    )
+    parser.add_argument(
+        "--report-dir", default="reports",
+        help="Directory for greedy_batch_invariance.{md,json}.",
+    )
+    args = parser.parse_args()
+
+    backend, model, dtype, quantization, max_tokens = build_backend(args)
 
     scenario = SCENARIOS[1]
     opinions = list(scenario["agent_opinions"].values())
@@ -100,6 +123,7 @@ def main() -> None:
 
     payload = {
         "generated": datetime.now().isoformat(timespec="seconds"),
+        "backend": args.backend,
         "model": model,
         "dtype": dtype,
         "quantization": quantization,
@@ -108,8 +132,8 @@ def main() -> None:
         "token_identical": invariant,
         "mismatching_compositions": [k for k, bad in mismatches.items() if bad],
     }
-    reports = pathlib.Path("reports")
-    reports.mkdir(exist_ok=True)
+    reports = pathlib.Path(args.report_dir)
+    reports.mkdir(parents=True, exist_ok=True)
     (reports / "greedy_batch_invariance.json").write_text(
         json.dumps(payload, indent=2)
     )
@@ -117,6 +141,7 @@ def main() -> None:
         "# Greedy batch-composition invariance (habermas retry-elision premise)",
         "",
         f"- Generated: {payload['generated']}",
+        f"- Backend: {args.backend}",
         f"- Model: {model} ({dtype}, quant={quantization}), greedy, "
         f"{max_tokens} tokens",
         "- Premise under test: argmax decode is invariant to batch width / "

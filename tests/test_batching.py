@@ -324,7 +324,7 @@ class TestFlushSingleFile:
                         # arrival, bounded so a broken follower can't hang.
                         deadline = time.monotonic() + 10.0
                         while time.monotonic() < deadline:
-                            with self.batching._cond:
+                            with self.batching._lock:
                                 if len(self.batching._queues["generate"]) >= 5:
                                     break
                             time.sleep(0.005)
@@ -438,7 +438,7 @@ class TestAbortedFlushFailsWaiters:
 
             deadline = time.monotonic() + 10.0
             while time.monotonic() < deadline:
-                with batching._cond:
+                with batching._lock:
                     if batching._queues["score"]:
                         break
                 time.sleep(0.005)
@@ -449,3 +449,71 @@ class TestAbortedFlushFailsWaiters:
         scorer_thread.join(timeout=10.0)
         assert not scorer_thread.is_alive(), "score waiter was stranded"
         assert "aborted" in score_outcome.get("result", "")
+
+
+class TestPerKindWakeups:
+    def test_no_spurious_wakeups_across_kinds(self):
+        """An all-blocked flush dispatches every kind's batch in sequence;
+        a waiter parked for the score batch must sleep through the generate
+        batch's completion (wakeups are routed per kind, not broadcast).
+        Pinned two ways: the spurious-wakeup counter stays 0, and the
+        queue-wait histogram shows each kind's entry dispatched exactly
+        once."""
+        import time
+
+        from consensus_tpu.obs import Registry
+
+        class SlowGenerate(CountingBackend):
+            # Slow enough that the score waiter is reliably parked in its
+            # untimed mid-flush wait while generate completes.
+            def generate(self, requests):
+                time.sleep(0.05)
+                return super().generate(requests)
+
+        registry = Registry()
+        inner = SlowGenerate()
+        batching = BatchingBackend(
+            inner, flush_ms=500.0, expected_sessions=2, registry=registry
+        )
+        out = {}
+
+        def gen_worker():
+            with batching.session():
+                out["gen"] = batching.generate(
+                    [GenerationRequest(user_prompt="a", max_tokens=4, seed=1)]
+                )
+
+        def score_worker():
+            with batching.session():
+                out["score"] = batching.score(
+                    [ScoreRequest(context="ctx", continuation=" more")]
+                )
+
+        threads = [
+            threading.Thread(target=gen_worker),
+            threading.Thread(target=score_worker),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert out["gen"][0].text is not None
+        assert out["score"][0].ok
+        # Both kinds rode ONE all-blocked flush (flush_ms is far above the
+        # test's runtime, so a timeout flush would fail the join above).
+        assert inner.batches["generate"] == 1
+        assert inner.batches["score"] == 1
+
+        families = registry.snapshot()["families"]
+
+        def series(name):
+            return {
+                tuple(s["labels"].values()): s
+                for s in families[name]["series"]
+            }
+
+        spurious = series("batching_spurious_wakeups_total")
+        assert sum(s["value"] for s in spurious.values()) == 0, spurious
+        waits = series("batching_queue_wait_seconds")
+        assert waits[("generate",)]["count"] == 1
+        assert waits[("score",)]["count"] == 1

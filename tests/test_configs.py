@@ -95,14 +95,26 @@ class TestMainBodyAndExamples:
         config = _load(REPO / "configs/examples/mcts.yaml")
         assert config["methods_to_run"] == ["mcts"]
         assert config["mcts"]["num_simulations"] == 3
+        assert config["mcts"]["mcts_wave_size"] == 8
 
     def test_north_star_tree(self):
         paths = sorted((REPO / "configs/north_star").glob("*/scenario_*/*.yaml"))
-        assert len(paths) == 20  # 5 scenarios x 4 method files
+        assert len(paths) == 25  # 5 scenarios x 5 method files (incl. mcts)
         for path in paths:
             config = _load(path)
             assert config["backend_options"]["model"] == "gemma2-2b"
             assert config["num_seeds"] == 5
+        mcts = [p for p in paths if p.name == "mcts.yaml"]
+        assert len(mcts) == 5
+        for path in mcts:
+            config = _load(path)
+            # Reference-default search scale, wave-parallel device path on.
+            assert config["mcts"]["num_simulations"] == 50
+            assert config["mcts"]["mcts_wave_size"] == 8
+
+    def test_mcts_timing_sweeps_wave_widths(self):
+        config = _load(REPO / "configs/examples/mcts_timing.yaml")
+        assert config["mcts"]["mcts_wave_size"] == [1, 8]
 
 
 class TestSweepDriverDiscovery:

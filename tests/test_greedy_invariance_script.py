@@ -1,0 +1,50 @@
+"""End-to-end run of scripts/greedy_batch_invariance_check.py in fake mode.
+
+The hardware check (--quick / full TPU) can't run in CI, but its harness —
+composition sweep, target-row extraction, report writing, the
+token_identical verdict — can, against the deterministic fake backend.
+A harness bug (wrong target row, stale baseline key, broken report path)
+fails here before it burns a TPU run.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+
+def test_fake_backend_mode_end_to_end(tmp_path):
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=str(repo),
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "scripts/greedy_batch_invariance_check.py",
+            "--backend", "fake",
+            "--report-dir", str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(repo),
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # The fake backend's greedy decode hashes only (prompt, step), so its
+    # output is composition-invariant by construction — the harness must
+    # report exactly that.
+    assert "token_identical=True" in proc.stdout
+
+    payload = json.loads((tmp_path / "greedy_batch_invariance.json").read_text())
+    assert payload["backend"] == "fake"
+    assert payload["token_identical"] is True
+    assert payload["mismatching_compositions"] == []
+    assert len(payload["compositions"]) == 6
+
+    report = (tmp_path / "greedy_batch_invariance.md").read_text()
+    assert "INVARIANT" in report

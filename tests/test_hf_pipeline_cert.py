@@ -29,6 +29,7 @@ from consensus_tpu.backends.base import (  # noqa: E402
     GenerationResult,
     ScoreRequest,
     ScoreResult,
+    TokenCandidate,
 )
 from consensus_tpu.backends.tpu import TPUBackend  # noqa: E402
 from consensus_tpu.evaluation import StatementEvaluator  # noqa: E402
@@ -116,8 +117,41 @@ class TorchRefBackend:
             vectors.append(pooled / max(np.linalg.norm(pooled), 1e-12))
         return np.stack(vectors)
 
-    def next_token_logprobs(self, requests):  # pragma: no cover - unused
-        return [[] for _ in requests]
+    def next_token_logprobs(self, requests):
+        """Deterministic top-k proposals, mirroring the production backend's
+        semantics for ``mode=="topk"`` or ``temperature<=0`` rows (the only
+        rows whose Gumbel term is zeroed there, generate.py:next_token_topk):
+        bias added to LOGITS over every token id containing each banned
+        string, then top-k of the biased log-softmax."""
+        results = []
+        for request in requests:
+            if request.mode != "topk" and request.temperature > 0:
+                raise NotImplementedError(
+                    "torch reference implements deterministic proposals only"
+                )
+            ids = self.tokenizer.encode(
+                self._render_prompt(request), add_bos=True
+            )
+            with torch.no_grad():
+                logits = self.model(
+                    input_ids=torch.tensor([ids])
+                ).logits[0, -1].float()
+            for text in request.bias_against_tokens:
+                for token_id in self.tokenizer.token_ids_containing(text):
+                    logits[token_id] += request.bias_value
+            logprobs = torch.log_softmax(logits, dim=-1)
+            top = torch.topk(logprobs, min(request.k, logprobs.shape[-1]))
+            results.append(
+                [
+                    TokenCandidate(
+                        token=self.tokenizer.decode([int(i)]),
+                        token_id=int(i),
+                        logprob=float(v),
+                    )
+                    for v, i in zip(top.values, top.indices)
+                ]
+            )
+        return results
 
 
 def _hf_tiny_gemma2_long():
@@ -190,6 +224,33 @@ def test_metric_columns_agree(stacks):
     for key in sorted(keys_t):
         a, b = metrics["torch"][key], metrics["jax"][key]
         assert a == pytest.approx(b, rel=2e-3, abs=2e-3), key
+
+
+def test_mcts_cell_through_both_stacks(stacks):
+    """Session-driven search through both stacks: torch runs MCTS over the
+    full-prefix fallback session (next_token_logprobs + score + generate),
+    jax over the fused TPU session (persistent KV caches, batched wave
+    rollouts) — same weights, same statement.  temperature=0 keeps both
+    proposal paths on deterministic top-k, so any divergence isolates to
+    session/search logic rather than sampling streams."""
+    from consensus_tpu.methods.mcts import MCTSGenerator
+
+    torch_backend, jax_backend = stacks
+    cfg = {
+        "num_simulations": 2,
+        "expansion_sample_width": 2,
+        "max_tokens": 3,
+        "rollout_depth": 2,
+        "temperature": 0.0,
+        "seed": 5,
+        "mcts_wave_size": 2,
+    }
+    statements = {}
+    for name, backend in (("torch", torch_backend), ("jax", jax_backend)):
+        gen = MCTSGenerator(backend, dict(cfg))
+        statements[name] = gen.generate_statement(ISSUE, OPINIONS)
+        assert gen.search_stats["device_dispatches"] > 0
+    assert statements["torch"] == statements["jax"]
 
 
 def test_greedy_generation_token_identical(stacks):

@@ -1,0 +1,129 @@
+"""Wave-parallel MCTS: parity, determinism, and dispatch accounting.
+
+The wave rewrite (methods/mcts.py) must be invisible at ``mcts_wave_size=1``
+— bit-identical statements AND node-visit counts versus the pre-change
+sequential search, pinned here against goldens captured from that code —
+and must actually pay for itself at wave=8: the acceptance bar is >= 4x
+fewer backend dispatches per statement at reference-default MCTS scale.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.methods.mcts import MCTSGenerator
+
+ISSUE = "Should schools adopt a four-day week?"
+OPINIONS = {
+    "Agent 1": "A shorter week improves wellbeing for students and teachers.",
+    "Agent 2": "Childcare burdens would fall on working parents.",
+    "Agent 3": "Evidence on learning outcomes is mixed; pilot first.",
+}
+
+GOLDENS = json.loads(
+    (Path(__file__).parent / "golden" / "mcts_wave1_goldens.json").read_text()
+)
+
+
+def run(config):
+    gen = MCTSGenerator(FakeBackend(), dict(config))
+    statement = gen.generate_statement(ISSUE, OPINIONS)
+    return statement, gen.search_stats
+
+
+@pytest.mark.parametrize("case", sorted(GOLDENS))
+def test_wave1_matches_pre_change_sequential_search(case):
+    """wave=1 replays the pre-change search exactly: same statement, same
+    per-step root-children visit counts (goldens captured before the wave
+    rewrite landed)."""
+    golden = GOLDENS[case]
+    statement, stats = run(golden["config"])
+    assert statement == golden["statement"]
+    got_log = [
+        [list(pair) for pair in step] for step in stats["visit_log"]
+    ]
+    assert got_log == golden["visit_log"]
+
+
+def test_wave1_explicit_config_matches_default():
+    cfg = dict(GOLDENS["tiny"]["config"])
+    cfg["mcts_wave_size"] = 1
+    statement, stats = run(cfg)
+    assert statement == GOLDENS["tiny"]["statement"]
+    assert stats["collisions"] == 0  # virtual loss never engages at width 1
+
+
+def test_wave8_deterministic_across_fresh_runs():
+    cfg = dict(GOLDENS["small"]["config"])
+    cfg["mcts_wave_size"] = 8
+    s1, stats1 = run(cfg)
+    s2, stats2 = run(cfg)
+    assert s1 == s2
+    assert stats1["visit_log"] == stats2["visit_log"]
+
+
+def test_wave8_cuts_dispatches_at_least_4x():
+    """Acceptance bar: at reference-default MCTS scale (num_simulations=50,
+    expansion_sample_width=5, rollout_depth=10 — configs/examples), the obs
+    dispatch counter shows >= 4x fewer backend calls per statement at wave=8
+    vs wave=1.  ``pin_budget`` is the repo's timing mode: no terminal nodes,
+    so every simulation issues real device work (without it the fake
+    backend's early-EOS trees leave most simulations dispatch-free and the
+    ratio measures tree shape, not batching)."""
+    base = {
+        "num_simulations": 50,
+        "expansion_sample_width": 5,
+        "max_tokens": 5,
+        "rollout_depth": 10,
+        "gamma": 0.99,
+        "seed": 0,
+        "pin_budget": True,
+    }
+    _, seq = run({**base, "mcts_wave_size": 1})
+    _, wave = run({**base, "mcts_wave_size": 8})
+    steps = len(seq["visit_log"])
+    assert steps == len(wave["visit_log"]) == base["max_tokens"]
+    per_seq = seq["device_dispatches"] / steps
+    per_wave = wave["device_dispatches"] / steps
+    assert per_seq / per_wave >= 4.0, (per_seq, per_wave)
+    # The wave run really ran wide — and virtual loss had work to do.
+    assert wave["waves"] < seq["waves"]
+    assert wave["collisions"] > 0
+
+
+def test_virtual_loss_reverts_exactly():
+    """After every wave, transient virtual-loss visits must be unwound
+    exactly — drift would contaminate UCB1 for the rest of the search.
+    Each of the ``num_simulations`` selections backpropagates exactly one
+    durable visit through the root, so the root's visit count must grow by
+    exactly ``num_simulations`` per step (the tree advances into the best
+    child, which carries its prior-step visits) iff no virtual visit
+    leaked."""
+    deltas = []
+    snapshot = {}  # id(node) -> visits when its parent was the root
+
+    class CapturingMCTS(MCTSGenerator):
+        def _most_visited_child(self, root):  # shadows the staticmethod
+            deltas.append(root.visits - snapshot.get(id(root), 0))
+            snapshot.clear()
+            snapshot.update(
+                (id(child), child.visits)
+                for child in root.children.values()
+            )
+            return MCTSGenerator._most_visited_child(root)
+
+    cfg = dict(GOLDENS["small"]["config"])
+    cfg["mcts_wave_size"] = 8
+    gen = CapturingMCTS(FakeBackend(), cfg)
+    gen.generate_statement(ISSUE, OPINIONS)
+    assert deltas and gen.search_stats["collisions"] > 0
+    assert deltas == [cfg["num_simulations"]] * len(deltas)
+
+
+def test_search_stats_surface():
+    statement, stats = run(GOLDENS["tiny"]["config"])
+    assert stats["wave_size"] == 1
+    assert stats["device_dispatches"] > 0
+    assert stats["selections"] == stats["waves"]  # width 1: one per wave

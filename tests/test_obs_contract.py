@@ -194,6 +194,7 @@ def test_bench_emits_one_parseable_json_line_with_obs_keys():
         BENCH_CONCURRENT="2",
         BENCH_TRIALS="1",
         BENCH_QUANT="none",
+        BENCH_MCTS_SIMS="6",  # keep the MCTS extra's CPU cost bounded
     )
     proc = subprocess.run(
         [sys.executable, "bench.py"],
@@ -220,3 +221,6 @@ def test_bench_emits_one_parseable_json_line_with_obs_keys():
     assert extra["tokens_per_sec"] > 0
     assert "bon_throughput_tokens_all_trials" in extra
     assert "bon_throughput_walls_sum_s" in extra
+    assert extra["mcts_seconds_per_statement"] > 0
+    assert extra["mcts_device_dispatches_per_statement"] > 0
+    assert extra["mcts_wave_size"] == 8

@@ -324,3 +324,107 @@ def test_beam_search_runs_on_tpu_session(backend):
         {"beam_width": 2, "max_tokens": 6, "seed": 3},
     )
     assert gen2.generate_statement(issue, opinions) == statement
+
+
+def test_rollout_many_matches_rollout_from(backend):
+    """Batched device rollouts (one fused multi-path program per span
+    group) == the singleton rollout path, token-for-token: each row folds
+    the same (family=2, salt) PRNG stream, and the shared-trunk scratch
+    cache sees the same prefix state."""
+    spec = make_spec(n_slots=1, sample=False, k=3)
+    tpu = TPUTokenSearchSession(backend, spec)
+    root = tpu.propose()[0]
+    suf_a, suf_b = [root[0]], [root[1]]
+    suf_deep = [root[0], root[0]]
+
+    singles = [
+        tpu.rollout_from(suf_a, depth=4, salt=9),
+        tpu.rollout_from(suf_b, depth=4, salt=10),
+        tpu.rollout_from(suf_deep, depth=4, salt=11),
+    ]
+    # Mixed-length batch: span-1 group {a, b} fuses into one program,
+    # span-2 group {deep} is a singleton and delegates to rollout_from.
+    batch = tpu.rollout_many(
+        [suf_a, suf_b, suf_deep], depth=4, salts=[9, 10, 11]
+    )
+    assert len(batch) == 3
+    for got, want in zip(batch, singles):
+        assert got[0] == want[0]  # token ids
+        assert got[1] == want[1]  # text
+        np.testing.assert_allclose(got[2], want[2], atol=2e-3)
+        assert got[3] == want[3]
+
+    # Determinism across repeat batched calls.
+    again = tpu.rollout_many(
+        [suf_a, suf_b, suf_deep], depth=4, salts=[9, 10, 11]
+    )
+    assert [r[0] for r in again] == [r[0] for r in batch]
+    tpu.close()
+
+
+def test_rollout_many_chunks_within_budget(backend):
+    """More paths than the HBM-derived chunk cap still come back right —
+    the batch is split into cap-sized fused calls."""
+    spec = make_spec(n_slots=1, sample=False, k=3)
+    tpu = TPUTokenSearchSession(backend, spec)
+    root = tpu.propose()[0]
+    cap = tpu._rollout_chunk_cap(1, 3)
+    assert cap >= 1
+    n = cap + 2  # force at least two chunks
+    suffixes = [[root[i % len(root)]] for i in range(n)]
+    salts = list(range(30, 30 + n))
+    before = tpu.dispatch_count
+    batch = tpu.rollout_many(suffixes, depth=3, salts=salts)
+    assert tpu.dispatch_count - before >= 2
+    for i, got in enumerate(batch):
+        want = tpu.rollout_from(suffixes[i], depth=3, salt=salts[i])
+        assert got[0] == want[0]
+        np.testing.assert_allclose(got[2], want[2], atol=2e-3)
+    tpu.close()
+
+
+def test_mixed_length_propose_suffixes(backend):
+    """propose_suffixes now accepts mixed suffix lengths in one call by
+    grouping per span; results come back in input order and singleton-span
+    calls keep the historical plain-salt PRNG stream."""
+    spec = make_spec(n_slots=1, sample=False, k=2)
+    tpu = TPUTokenSearchSession(backend, spec)
+    root = tpu.propose()[0]
+    s1, s2 = [root[0]], [root[1]]
+    deep = [root[0], root[0]]
+
+    mixed = tpu.propose_suffixes([s1, deep, s2], salt=5)
+    assert len(mixed) == 3
+    # Each span group matches a homogeneous call with that group's salt
+    # (salt ^ (span << 20) once more than one span is present).
+    only1 = tpu.propose_suffixes([s1, s2], salt=5 ^ (1 << 20))
+    only2 = tpu.propose_suffixes([deep], salt=5 ^ (2 << 20))
+    assert [c.token_id for c in mixed[0]] == [c.token_id for c in only1[0]]
+    assert [c.token_id for c in mixed[2]] == [c.token_id for c in only1[1]]
+    assert [c.token_id for c in mixed[1]] == [c.token_id for c in only2[0]]
+    with pytest.raises(ValueError):
+        tpu.propose_suffixes([s1, []], salt=6)
+    tpu.close()
+
+
+def test_mcts_wave_runs_on_tpu_session(backend):
+    """Wave-parallel MCTS end-to-end through the fused TPU session: batched
+    expansion + batched rollouts, deterministic across fresh runs."""
+    from consensus_tpu.methods import get_method_generator
+
+    issue = "Should the town build a new library?"
+    opinions = {
+        "Agent 1": "Yes, libraries anchor the community.",
+        "Agent 2": "Only if it does not raise taxes.",
+    }
+    cfg = {
+        "num_simulations": 4, "expansion_sample_width": 2,
+        "max_tokens": 3, "rollout_depth": 2, "seed": 6,
+        "mcts_wave_size": 4,
+    }
+    gen = get_method_generator("mcts", backend, cfg)
+    statement = gen.generate_statement(issue, opinions)
+    assert isinstance(statement, str)
+    assert gen.search_stats["device_dispatches"] > 0
+    gen2 = get_method_generator("mcts", backend, cfg)
+    assert gen2.generate_statement(issue, opinions) == statement
