@@ -216,6 +216,39 @@ def main() -> None:
             "mcts_virtual_loss_collisions": stats["collisions"],
         }
 
+    # ---- online serving cell (fake backend, scheduler + HTTP stack) --
+    # Short fixed-rate open-loop run through the full serve path
+    # (admission -> worker pool -> shared BatchingBackend): throughput,
+    # tail latency, and rejection rate of the subsystem itself, decoupled
+    # from device speed.  BENCH_SERVE=0 skips; BENCH_SERVE_REQUESTS /
+    # BENCH_SERVE_RATE rescale.
+    serve_extra = {}
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+
+        serve_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "32"))
+        serve_rate = float(os.environ.get("BENCH_SERVE_RATE", "50"))
+        server = create_server(backend="fake", port=0, max_inflight=4).start()
+        try:
+            serve_report = run_loadgen(
+                server.base_url,
+                scenario_requests(serve_requests, params={
+                    "n": 8, "max_tokens": NEW_TOKENS}),
+                rate_rps=serve_rate,
+            )
+        finally:
+            server.stop()
+        serve_extra = {
+            "serve_throughput_rps": serve_report["throughput_rps"],
+            "serve_p50_ms": serve_report["latency_ms"]["p50"],
+            "serve_p99_ms": serve_report["latency_ms"]["p99"],
+            "serve_rejected_frac": serve_report["rejection_rate"],
+            "serve_offered_rate_rps": serve_report["offered_rate_rps"],
+            "serve_requests": serve_requests,
+            "serve_backend": "fake (subsystem cost, not device speed)",
+        }
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -320,6 +353,7 @@ def main() -> None:
                         lookahead_sps / BASELINE_LOOKAHEAD_STATEMENTS_PER_SEC, 2
                     ),
                     **mcts_extra,
+                    **serve_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

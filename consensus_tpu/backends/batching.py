@@ -46,7 +46,7 @@ from consensus_tpu.obs.metrics import (
 
 
 class _Pending:
-    __slots__ = ("requests", "result", "error", "done", "enqueued")
+    __slots__ = ("requests", "result", "error", "done", "enqueued", "in_flight")
 
     def __init__(self, requests):
         self.requests = requests
@@ -54,6 +54,10 @@ class _Pending:
         self.error = None
         self.done = False
         self.enqueued = time.perf_counter()
+        #: True once a flush has snapshotted this entry off its queue — its
+        #: waiter then parks on the kind's DISPATCH condition, which is only
+        #: notified when the entry's own batch completes (or aborts).
+        self.in_flight = False
 
 
 class BatchingBackend:
@@ -109,10 +113,17 @@ class BatchingBackend:
         #: is suppressed — otherwise the first worker to enqueue during pool
         #: ramp-up sees active==1 and flushes a batch of one.
         self.expected_sessions = max(1, expected_sessions)
-        #: One lock guards all queues/flags; each kind waits on its OWN
-        #: condition over that lock, so a completed generate batch can wake
-        #: generate's waiters without stampeding score/next_token waiters
-        #: parked through the same flush.
+        #: One lock guards all queues/flags; each kind waits on its OWN pair
+        #: of conditions over that lock.  ``_conds[kind]`` is the QUEUE
+        #: condition (entry still on its queue: flush decisions, flush-end
+        #: re-evaluation); ``_dispatch_conds[kind]`` is the DISPATCH
+        #: condition (entry snapshotted into a running flush: woken exactly
+        #: when its batch completes or the flush aborts).  The split is what
+        #: lets a completed generate batch wake precisely the waiters whose
+        #: entries finished — same-kind requests that arrived DURING the
+        #: flush park on the queue condition and sleep through it (ADVICE r5
+        #: item 4; ``batching_spurious_wakeups_total`` pins this at 0 under
+        #: mixed-kind serving load).
         self._lock = threading.Lock()
         self._active = 0
         self._started = 0
@@ -121,6 +132,9 @@ class BatchingBackend:
             "generate": [], "score": [], "next_token": [], "embed": [],
         }
         self._conds: Dict[str, threading.Condition] = {
+            kind: threading.Condition(self._lock) for kind in self._queues
+        }
+        self._dispatch_conds: Dict[str, threading.Condition] = {
             kind: threading.Condition(self._lock) for kind in self._queues
         }
         #: Device batches actually issued per kind — the measurable win:
@@ -170,8 +184,13 @@ class BatchingBackend:
             with self._lock:
                 self._active -= 1
                 # A departing session may complete the "all blocked"
-                # condition for a waiter of ANY kind.
-                self._notify(self._queues)
+                # condition for a waiter of ANY kind.  Mid-flush the
+                # predicate can't be acted on anyway (waiters are parked
+                # untimed and re-evaluate at flush end), so skip the
+                # broadcast rather than charge every parked waiter a
+                # spurious wakeup.
+                if not self._flushing:
+                    self._notify(self._queues)
 
     # -- protocol ----------------------------------------------------------
 
@@ -224,20 +243,29 @@ class BatchingBackend:
         with cond:
             self._queues[kind].append(entry)
             # An append changes the pending count that feeds EVERY kind's
-            # all-blocked predicate, so it broadcasts across kinds.
-            self._notify(self._queues)
+            # all-blocked predicate, so it broadcasts across kinds — except
+            # mid-flush, when nobody can act on the predicate (parked
+            # waiters re-evaluate at flush end, which notifies every kind
+            # whose queue refilled).
+            if not self._flushing:
+                self._notify(self._queues)
             while not entry.done:
                 if self._flushing:
-                    # A device batch is executing with the lock released:
-                    # this entry rides the NEXT flush, merged with everything
-                    # else that arrives during the multi-second device call.
-                    # Untimed: flush end wakes every kind with snapshot or
-                    # queued entries under the lock (including on abort —
-                    # _flush's finally errors stranded entries), so polling
-                    # here would only burn host cycles.  Completion wakeups
-                    # are per kind; waking here with the flush still running
-                    # and this entry still pending means a wakeup was wasted.
-                    cond.wait()
+                    # A device batch is executing with the lock released.
+                    # Snapshotted entries park on the dispatch condition:
+                    # it is notified exactly when their own batch completes
+                    # (or the flush aborts), so a completed generate batch
+                    # never stampedes score waiters in the same flush, and
+                    # generate requests that arrived AFTER the snapshot
+                    # sleep on the queue condition until flush end.  Both
+                    # waits are untimed: flush end / completion wakes them
+                    # under the lock, so polling would only burn host
+                    # cycles.  Waking here with the flush still running and
+                    # this entry still pending means a wakeup was wasted.
+                    if entry.in_flight:
+                        self._dispatch_conds[kind].wait()
+                    else:
+                        cond.wait()
                     if self._flushing and not entry.done:
                         self._spurious_wakeups.labels(kind).inc()
                     continue
@@ -277,7 +305,14 @@ class BatchingBackend:
         try:
             for k in kinds:
                 snapshot[k] = self._queues[k]
+                for entry in snapshot[k]:
+                    entry.in_flight = True
                 self._queues[k] = []
+            # Snapshotted kinds' waiters may be sitting in TIMED queue-cond
+            # waits; wake them (still under the lock) so they re-park on the
+            # dispatch condition — otherwise they'd miss their completion
+            # wakeup and sleep out the rest of their quiescence window.
+            self._notify(k for k in kinds if snapshot[k])
             self._lock.release()
             released = True
             self._run_batches(snapshot, reason)
@@ -301,16 +336,17 @@ class BatchingBackend:
                             "dispatched"
                         )
                         entry.done = True
-            # Flush end wakes only kinds that can have a waiter parked or
-            # pending: snapshot kinds (their entries just completed — the
-            # happy path already woke them mid-flush, but the abort path
-            # above may have errored them here) and kinds whose queues
-            # refilled during the flush (those waiters sat out the untimed
-            # wait and must re-evaluate now that _flushing cleared).
-            self._notify(
-                {k for k, q in snapshot.items() if q}
-                | {k for k, q in self._queues.items() if q}
-            )
+            # Flush end wakes only conditions that can have a waiter parked:
+            # snapshot kinds' DISPATCH conditions (happy-path waiters
+            # already woke mid-flush and are gone — this covers the abort
+            # path that errored entries just above) and the QUEUE conditions
+            # of kinds whose queues refilled during the flush (those waiters
+            # sat out the untimed wait and must re-evaluate now that
+            # _flushing cleared).
+            for k, q in snapshot.items():
+                if q:
+                    self._dispatch_conds[k].notify_all()
+            self._notify(k for k, q in self._queues.items() if q)
 
     def _run_batches(
         self, snapshot: Dict[str, List[_Pending]], reason: str
@@ -350,15 +386,17 @@ class BatchingBackend:
                 for entry in queue:
                     entry.error = exc
                     entry.done = True
-            # Wake this kind's waiters NOW rather than at flush end: their
-            # host-side work (parsing, prompt building) overlaps the
-            # remaining kinds' device dispatches — mid-flush waiters park in
-            # an untimed wait and would otherwise sleep out the whole flush.
-            # Only THIS kind's condition is notified: the other kinds'
-            # waiters have nothing new to learn until their own batch (or
-            # the flush end) completes, and waking them would just burn a
-            # scheduler round trip per parked thread (the spurious-wakeup
-            # counter pins this at zero).
-            cond = self._conds[kind]
+            # Wake this kind's completed waiters NOW rather than at flush
+            # end: their host-side work (parsing, prompt building) overlaps
+            # the remaining kinds' device dispatches — mid-flush waiters
+            # park in an untimed wait and would otherwise sleep out the
+            # whole flush.  Only THIS kind's DISPATCH condition is notified,
+            # and only snapshotted (now done) entries wait there: other
+            # kinds' waiters have nothing new to learn, and same-kind
+            # requests that arrived after the snapshot park on the queue
+            # condition until flush end — so every wakeup issued here finds
+            # a finished entry (the spurious-wakeup counter pins this at
+            # zero).
+            cond = self._dispatch_conds[kind]
             with cond:
                 cond.notify_all()

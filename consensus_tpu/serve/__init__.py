@@ -1,0 +1,60 @@
+"""Online serving subsystem: scheduler + service + HTTP front end.
+
+Turns the batch pipeline into a service (ROADMAP north star: "serve heavy
+traffic").  The layering, front to back:
+
+    HTTP handler threads      http_frontend.ConsensusHTTPServer
+      └─ admission + queue    scheduler.RequestScheduler (bounded FIFO,
+         └─ worker pool          deadlines, retry, drain)
+            └─ decode+score   service.ConsensusService (GENERATOR_MAP)
+               └─ merge layer backends.batching.BatchingBackend (shared)
+                  └─ engine   FakeBackend / TPUBackend
+
+``python -m consensus_tpu.serve --backend fake`` runs a hardware-free
+server; ``serve.loadgen`` replays AAMAS scenarios against it.
+"""
+
+from consensus_tpu.serve.http_frontend import ConsensusServer  # noqa: F401
+from consensus_tpu.serve.scheduler import (  # noqa: F401
+    RequestScheduler,
+    RequestTimeout,
+    SchedulerRejected,
+    Ticket,
+)
+from consensus_tpu.serve.service import (  # noqa: F401
+    ConsensusRequest,
+    ConsensusService,
+    RequestValidationError,
+    parse_request,
+)
+
+
+def create_server(
+    backend="fake",
+    backend_options=None,
+    host: str = "127.0.0.1",
+    port: int = 8080,
+    max_queue_depth: int = 64,
+    max_inflight: int = 4,
+    default_timeout_s=120.0,
+    max_retries: int = 2,
+    flush_ms: float = 10.0,
+    generation_model: str = "",
+    registry=None,
+) -> ConsensusServer:
+    """Wire backend → service → scheduler → HTTP server (not yet started)."""
+    from consensus_tpu.backends import get_backend
+
+    engine = get_backend(backend, **(backend_options or {}))
+    service = ConsensusService(engine, generation_model=generation_model)
+    scheduler = RequestScheduler(
+        handler=service.run,
+        backend=engine,
+        max_queue_depth=max_queue_depth,
+        max_inflight=max_inflight,
+        default_timeout_s=default_timeout_s,
+        max_retries=max_retries,
+        flush_ms=flush_ms,
+        registry=registry,
+    )
+    return ConsensusServer(scheduler, host=host, port=port, registry=registry)

@@ -1,0 +1,103 @@
+"""``python -m consensus_tpu.serve`` — run the consensus HTTP server.
+
+Quickstart (hardware-free):
+
+    python -m consensus_tpu.serve --backend fake --port 8080
+
+    curl -s localhost:8080/v1/consensus -d '{
+      "issue": "Should we invest in public transport?",
+      "agent_opinions": {"Agent 1": "Yes, buses are vital.",
+                         "Agent 2": "Only with congestion pricing."},
+      "method": "best_of_n", "params": {"n": 4, "max_tokens": 32},
+      "seed": 7}'
+
+SIGINT/SIGTERM drains gracefully: admission closes (new requests get 429),
+queued and in-flight requests finish, then the process exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m consensus_tpu.serve",
+        description="Online consensus-statement server.",
+    )
+    parser.add_argument("--backend", default="fake",
+                        help="backend name: fake | tpu | api (default: fake)")
+    parser.add_argument("--backend-options", default="{}",
+                        help="JSON object of backend constructor kwargs "
+                             '(e.g. \'{"checkpoint": "/path/to/hf"}\')')
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--max-queue-depth", type=int, default=64,
+                        help="admission queue bound; beyond it requests get "
+                             "an explicit 429 (default: 64)")
+    parser.add_argument("--max-inflight", type=int, default=4,
+                        help="worker pool size = concurrently executing "
+                             "requests sharing one BatchingBackend "
+                             "(default: 4)")
+    parser.add_argument("--default-timeout-s", type=float, default=120.0,
+                        help="per-request deadline when the client sends "
+                             "none (default: 120)")
+    parser.add_argument("--max-retries", type=int, default=2,
+                        help="transient-failure retries per request "
+                             "(default: 2)")
+    parser.add_argument("--flush-ms", type=float, default=10.0,
+                        help="BatchingBackend quiescence window (default: 10)")
+    parser.add_argument("--generation-model", default="")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level.upper(),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    from consensus_tpu.serve import create_server
+
+    server = create_server(
+        backend=args.backend,
+        backend_options=json.loads(args.backend_options),
+        host=args.host,
+        port=args.port,
+        max_queue_depth=args.max_queue_depth,
+        max_inflight=args.max_inflight,
+        default_timeout_s=args.default_timeout_s,
+        max_retries=args.max_retries,
+        flush_ms=args.flush_ms,
+        generation_model=args.generation_model,
+    )
+    stop = threading.Event()
+
+    def handle_signal(signum, frame):
+        logging.getLogger("consensus_tpu.serve").info(
+            "signal %d: draining and shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle_signal)
+    signal.signal(signal.SIGTERM, handle_signal)
+
+    server.start()
+    print(json.dumps({
+        "serving": server.base_url,
+        "endpoints": ["POST /v1/consensus", "GET /healthz", "GET /metrics"],
+        "backend": args.backend,
+        "max_queue_depth": args.max_queue_depth,
+        "max_inflight": args.max_inflight,
+    }))
+    try:
+        stop.wait()
+    finally:
+        server.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,239 @@
+"""Stdlib-only HTTP front end for the consensus scheduler.
+
+``ThreadingHTTPServer`` (one thread per connection, stdlib, no new
+dependencies) in front of :class:`RequestScheduler`:
+
+* ``POST /v1/consensus`` — validate → admit → wait → respond.  Errors are
+  structured JSON (``{"error": {"type", "message", ...}}``) with the HTTP
+  status carrying the overload semantics: 400 validation, 429 admission
+  rejection (with ``Retry-After``), 504 deadline expiry, 500 terminal
+  backend failure.
+* ``GET /healthz`` — queue depth, in-flight count, drain state, backend
+  liveness, device-batch accounting (the coalescing proof surface).
+* ``GET /metrics`` — Prometheus text exposition straight from the obs
+  registry (the ``serve_*`` families plus everything the backends record).
+
+Handler threads block on their ticket while the scheduler's worker pool —
+not the connection pool — bounds device work; a handler thread waiting on
+an admitted ticket costs one parked thread, nothing on device.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from consensus_tpu.obs.metrics import Registry, get_registry
+from consensus_tpu.serve.scheduler import (
+    RequestScheduler,
+    RequestTimeout,
+    SchedulerRejected,
+)
+from consensus_tpu.serve.service import RequestValidationError, parse_request
+
+logger = logging.getLogger(__name__)
+
+#: Grace period past the request deadline before the handler gives up on
+#: its ticket — covers scheduler bookkeeping so the worker, not the
+#: handler's stopwatch, decides borderline timeouts.
+_WAIT_GRACE_S = 0.25
+#: Ticket wait for requests with no deadline at all.
+_UNBOUNDED_WAIT_S = 3600.0
+
+
+class ConsensusHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the scheduler + registry for handlers."""
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        scheduler: RequestScheduler,
+        registry: Optional[Registry] = None,
+    ):
+        super().__init__(address, ConsensusRequestHandler)
+        self.scheduler = scheduler
+        self.registry = registry if registry is not None else get_registry()
+
+
+class ConsensusRequestHandler(BaseHTTPRequestHandler):
+    server: ConsensusHTTPServer
+    protocol_version = "HTTP/1.1"
+
+    # -- routing -----------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        if self.path == "/healthz":
+            self._send_json(200, self._health_payload())
+        elif self.path == "/metrics":
+            body = self.server.registry.to_prometheus().encode("utf-8")
+            self._send_bytes(200, body, "text/plain; version=0.0.4")
+        else:
+            self._send_error_json(404, "not_found",
+                                  f"no route for GET {self.path}")
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/v1/consensus":
+            self._send_error_json(404, "not_found",
+                                  f"no route for POST {self.path}")
+            return
+        try:
+            payload = self._read_json()
+        except ValueError as exc:
+            self._send_error_json(400, "bad_json", str(exc))
+            return
+        try:
+            request = parse_request(payload)
+        except RequestValidationError as exc:
+            self._send_json(400, {"error": {
+                "type": "validation",
+                "message": "request failed validation",
+                "details": exc.errors,
+            }})
+            return
+        scheduler = self.server.scheduler
+        try:
+            ticket = scheduler.submit(request)
+        except SchedulerRejected as exc:
+            self._send_json(429, {"error": {
+                "type": "rejected",
+                "reason": exc.reason,
+                "message": str(exc),
+            }}, headers={"Retry-After": "1"})
+            return
+        remaining = ticket.remaining()
+        wait_s = (
+            remaining + _WAIT_GRACE_S if remaining is not None
+            else _UNBOUNDED_WAIT_S
+        )
+        if not ticket.wait(timeout=max(0.0, wait_s)):
+            # Cooperative cancellation: a queued ticket dies at pop; a
+            # running one completes server-side but is counted as timeout.
+            ticket.cancel()
+            self._send_error_json(
+                504, "timeout",
+                "deadline expired before the request completed")
+            return
+        try:
+            result = ticket.result()
+        except RequestTimeout as exc:
+            self._send_error_json(504, "timeout", str(exc))
+            return
+        except SchedulerRejected as exc:
+            self._send_json(429, {"error": {
+                "type": "rejected", "reason": exc.reason,
+                "message": str(exc),
+            }}, headers={"Retry-After": "1"})
+            return
+        except Exception as exc:
+            self._send_json(500, {"error": {
+                "type": "backend_failure",
+                "exception": type(exc).__name__,
+                "message": str(exc),
+                "attempts": ticket.attempts,
+            }})
+            return
+        self._send_json(200, result)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _health_payload(self) -> Dict[str, Any]:
+        scheduler = self.server.scheduler
+        stats = scheduler.stats()
+        inner = scheduler.inner_backend
+        stats["status"] = "draining" if stats["draining"] else "ok"
+        stats["backend"] = {
+            "name": getattr(inner, "name", type(inner).__name__),
+            "model": getattr(inner, "model_name", ""),
+            "alive": stats["workers_alive"] > 0,
+        }
+        return stats
+
+    def _read_json(self) -> Any:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise ValueError("empty request body (Content-Length required)")
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise ValueError(f"request body is not valid JSON: {exc}") from exc
+
+    def _send_json(self, status: int, payload: Dict[str, Any],
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self._send_bytes(status, body, "application/json", headers)
+
+    def _send_error_json(self, status: int, error_type: str,
+                         message: str) -> None:
+        self._send_json(status, {"error": {"type": error_type,
+                                           "message": message}})
+
+    def _send_bytes(self, status: int, body: bytes, content_type: str,
+                    headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format: str, *args) -> None:  # noqa: A002
+        logger.debug("%s - %s", self.address_string(), format % args)
+
+
+class ConsensusServer:
+    """Scheduler + HTTP front end with a test-friendly lifecycle.
+
+    ``start()`` binds (port 0 → ephemeral), spawns the serve loop thread
+    and the scheduler workers; ``stop()`` drains the scheduler and closes
+    the socket.  ``base_url`` is where clients (and the load generator)
+    point."""
+
+    def __init__(
+        self,
+        scheduler: RequestScheduler,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        registry: Optional[Registry] = None,
+    ):
+        self.scheduler = scheduler
+        self._httpd = ConsensusHTTPServer((host, port), scheduler, registry)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ConsensusServer":
+        self.scheduler.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._thread.start()
+        logger.info("consensus server listening on %s", self.base_url)
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = 30.0) -> None:
+        self.scheduler.shutdown(drain=drain, timeout=timeout)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def __enter__(self) -> "ConsensusServer":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()

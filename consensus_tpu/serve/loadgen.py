@@ -1,0 +1,186 @@
+"""Open-loop load generator for the consensus server.
+
+Open-loop means arrivals are scheduled on a fixed clock regardless of how
+fast responses come back — the regime that actually exposes tail latency
+and overload behaviour (a closed loop self-throttles and hides both).
+Request ``i`` is launched at ``t0 + i/rate`` on its own thread; each
+records latency and outcome, and the report aggregates throughput,
+p50/p95/p99 latency, and the rejection rate.
+
+Request bodies replay the AAMAS survey scenarios
+(``consensus_tpu/data/aamas_scenarios.py``) round-robin, with distinct
+seeds so the workload is deterministic but not degenerate-identical.
+Stdlib only (``urllib``), like the front end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from consensus_tpu.data.aamas_scenarios import SCENARIOS
+
+
+def scenario_requests(
+    count: int,
+    method: str = "best_of_n",
+    params: Optional[Dict[str, Any]] = None,
+    base_seed: int = 100,
+    evaluate: bool = False,
+    timeout_s: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """``count`` request payloads cycling the AAMAS scenarios."""
+    keys = sorted(SCENARIOS)
+    payloads = []
+    for i in range(count):
+        scenario = SCENARIOS[keys[i % len(keys)]]
+        payload: Dict[str, Any] = {
+            "issue": scenario["issue"],
+            "agent_opinions": dict(scenario["agent_opinions"]),
+            "method": method,
+            "params": dict(params or {}),
+            "seed": base_seed + i,
+            "evaluate": evaluate,
+            "request_id": f"loadgen-{i}",
+        }
+        if timeout_s is not None:
+            payload["timeout_s"] = timeout_s
+        payloads.append(payload)
+    return payloads
+
+
+@dataclasses.dataclass
+class RequestOutcome:
+    request_id: str
+    status: int  # HTTP status; 0 = transport error / client timeout
+    latency_s: float
+    error_type: str = ""
+    statement: str = ""
+
+
+def _percentile(sorted_values: List[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (no numpy needed for a
+    report, and nearest-rank keeps tiny samples honest)."""
+    if not sorted_values:
+        return float("nan")
+    rank = max(0, min(len(sorted_values) - 1,
+                      int(round(q * (len(sorted_values) - 1)))))
+    return sorted_values[rank]
+
+
+def run_loadgen(
+    base_url: str,
+    payloads: List[Dict[str, Any]],
+    rate_rps: float,
+    client_timeout_s: float = 60.0,
+) -> Dict[str, Any]:
+    """Replay ``payloads`` open-loop at ``rate_rps`` against ``base_url``.
+
+    Returns the report dict (see keys below); per-request outcomes ride
+    along under ``"outcomes"`` for callers that want the raw data (the
+    acceptance test compares statements against offline Experiment runs).
+    """
+    if rate_rps <= 0:
+        raise ValueError("rate_rps must be positive")
+    url = base_url.rstrip("/") + "/v1/consensus"
+    outcomes: List[Optional[RequestOutcome]] = [None] * len(payloads)
+
+    def fire(index: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        request = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        start = time.perf_counter()
+        try:
+            with urllib.request.urlopen(
+                request, timeout=client_timeout_s
+            ) as response:
+                data = json.loads(response.read().decode("utf-8"))
+                outcomes[index] = RequestOutcome(
+                    request_id=payload.get("request_id", str(index)),
+                    status=response.status,
+                    latency_s=time.perf_counter() - start,
+                    statement=data.get("statement", ""),
+                )
+        except urllib.error.HTTPError as exc:
+            try:
+                error = json.loads(exc.read().decode("utf-8")).get("error", {})
+            except Exception:
+                error = {}
+            outcomes[index] = RequestOutcome(
+                request_id=payload.get("request_id", str(index)),
+                status=exc.code,
+                latency_s=time.perf_counter() - start,
+                error_type=error.get("type", "http_error"),
+            )
+        except Exception as exc:
+            outcomes[index] = RequestOutcome(
+                request_id=payload.get("request_id", str(index)),
+                status=0,
+                latency_s=time.perf_counter() - start,
+                error_type=type(exc).__name__,
+            )
+
+    threads: List[threading.Thread] = []
+    start_wall = time.perf_counter()
+    for i, payload in enumerate(payloads):
+        # Open loop: hold the schedule even if earlier requests are slow.
+        target = start_wall + i / rate_rps
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        thread = threading.Thread(target=fire, args=(i, payload), daemon=True)
+        thread.start()
+        threads.append(thread)
+    for thread in threads:
+        thread.join(timeout=client_timeout_s + 5.0)
+    wall_s = time.perf_counter() - start_wall
+
+    def classify(outcome: RequestOutcome) -> str:
+        if outcome.status == 200:
+            return "ok"
+        if outcome.status == 429:
+            return "rejected"
+        if outcome.status == 504 or outcome.error_type == "timeout":
+            return "timeout"
+        return "failed"
+
+    done = [o for o in outcomes if o is not None]
+    buckets: Dict[str, List[RequestOutcome]] = {
+        "ok": [], "rejected": [], "timeout": [], "failed": []}
+    for outcome in done:
+        buckets[classify(outcome)].append(outcome)
+    ok, rejected = buckets["ok"], buckets["rejected"]
+    timeouts, failed = buckets["timeout"], buckets["failed"]
+    latencies = sorted(o.latency_s for o in ok)
+    return {
+        "requests": len(payloads),
+        "offered_rate_rps": rate_rps,
+        "wall_s": round(wall_s, 3),
+        "completed": len(ok),
+        "rejected": len(rejected),
+        "timeouts": len(timeouts),
+        "failed": len(failed),
+        "throughput_rps": round(len(ok) / wall_s, 3) if wall_s > 0 else 0.0,
+        "rejection_rate": round(len(rejected) / len(payloads), 4)
+        if payloads else 0.0,
+        "latency_ms": {
+            "p50": round(_percentile(latencies, 0.50) * 1e3, 2),
+            "p95": round(_percentile(latencies, 0.95) * 1e3, 2),
+            "p99": round(_percentile(latencies, 0.99) * 1e3, 2),
+            "max": round(latencies[-1] * 1e3, 2) if latencies else float("nan"),
+        },
+        "outcomes": done,
+    }
+
+
+def report_json(report: Dict[str, Any]) -> str:
+    """The report as JSON, outcomes elided (they hold full statements)."""
+    slim = {k: v for k, v in report.items() if k != "outcomes"}
+    return json.dumps(slim, indent=2)

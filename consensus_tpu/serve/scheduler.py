@@ -1,0 +1,388 @@
+"""Request scheduler: bounded admission in front of the batched engine.
+
+Continuous-batching serving (Orca / vLLM lineage) splits the server into a
+front half that decides WHAT runs and a back half that decides HOW it runs.
+The back half already exists here — ``BatchingBackend`` merges concurrent
+sessions' generate/score/embed calls into shared padded device batches —
+so this module supplies the front half:
+
+* **Bounded FIFO queue + admission control.**  ``submit`` either accepts a
+  request or raises :class:`SchedulerRejected` immediately (queue full /
+  draining).  Overload produces an explicit, cheap rejection the client
+  can retry against another replica — never unbounded queueing latency.
+* **Worker pool over ONE shared BatchingBackend.**  ``max_inflight``
+  workers each wrap a request in ``batching.session()`` (the same pattern
+  as ``experiment.py``'s concurrent path), so whatever is in flight
+  co-merges into wider device batches; admission and batching compose
+  without knowing about each other.
+* **Deadlines with cooperative cancellation.**  Every ticket carries a
+  monotonic deadline.  Expiry while queued is detected at pop; the waiter
+  (HTTP handler) can also ``cancel()`` a ticket it has given up on.  A
+  request already inside a device dispatch finishes (device programs are
+  not preemptible) but its result is discarded and counted as timeout.
+* **Bounded retry with backoff.**  Transient backend failures (e.g. an
+  aborted flush failing every waiter in its batch) retry up to
+  ``max_retries`` times with exponential backoff, capped by the ticket's
+  remaining deadline.  Validation errors never retry.
+* **Graceful drain.**  ``shutdown(drain=True)`` closes admission, lets the
+  queue and in-flight work complete, then joins the workers; no ticket is
+  ever left unresolved.
+
+Obs families (land in ``metrics.json`` / ``metrics.prom`` / ``/metrics``):
+``serve_queue_depth``, ``serve_inflight`` (gauges),
+``serve_request_latency_seconds{method,outcome}`` (histogram, submit→done),
+``serve_accepted_total``, ``serve_rejected_total{reason}``,
+``serve_timeout_total``, ``serve_retried_total``, ``serve_failed_total``.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from consensus_tpu.backends.base import Backend
+from consensus_tpu.backends.batching import BatchingBackend
+from consensus_tpu.obs.metrics import Registry, get_registry
+
+logger = logging.getLogger(__name__)
+
+#: Exception types considered transient (retryable).  Validation/config
+#: errors (ValueError/KeyError/TypeError) are not in this set on purpose:
+#: resubmitting a bad request can never succeed.
+TRANSIENT_EXCEPTIONS = (RuntimeError, ConnectionError, TimeoutError, OSError)
+
+
+class SchedulerRejected(Exception):
+    """Admission control refused the request (explicit overload signal)."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class RequestTimeout(Exception):
+    """The request's deadline expired before a result was produced."""
+
+
+class Ticket:
+    """Handle for one admitted request: wait / result / cancel."""
+
+    def __init__(self, request: Any, deadline: Optional[float]):
+        self.request = request
+        self.deadline = deadline  # monotonic seconds, None = no deadline
+        self.submitted = time.monotonic()
+        self.attempts = 0
+        self.outcome: Optional[str] = None  # "ok" | "timeout" | "failed"
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+
+    # -- waiter side -------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancel(self) -> None:
+        """Cooperative: a queued ticket is dropped at pop; a running one
+        completes but its result is discarded as a timeout."""
+        self._cancelled.set()
+
+    def result(self) -> Any:
+        """The response dict; raises the terminal error if the request did
+        not complete (RequestTimeout / SchedulerRejected / backend error)."""
+        if not self._done.is_set():
+            raise RequestTimeout("request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    # -- scheduler side ----------------------------------------------------
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def expired(self) -> bool:
+        remaining = self.remaining()
+        return remaining is not None and remaining <= 0
+
+    def _finish(self, outcome: str, value: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        self.outcome = outcome
+        self._value = value
+        self._error = error
+        self._done.set()
+
+
+class RequestScheduler:
+    """Bounded FIFO queue + worker pool over one shared BatchingBackend."""
+
+    def __init__(
+        self,
+        handler: Callable[[Any, Backend], Any],
+        backend: Backend,
+        max_queue_depth: int = 64,
+        max_inflight: int = 4,
+        default_timeout_s: Optional[float] = 120.0,
+        max_retries: int = 2,
+        retry_backoff_s: float = 0.05,
+        flush_ms: float = 10.0,
+        registry: Optional[Registry] = None,
+    ):
+        if max_queue_depth < 1 or max_inflight < 1:
+            raise ValueError("max_queue_depth and max_inflight must be >= 1")
+        self.handler = handler
+        self.inner_backend = backend
+        self.max_queue_depth = int(max_queue_depth)
+        self.max_inflight = int(max_inflight)
+        self.default_timeout_s = default_timeout_s
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        #: Shared merge layer: whatever is in flight co-batches.  Sessions
+        #: are entered per request (experiment.py's pattern), so the
+        #: all-blocked flush sees exactly the in-flight request count.
+        reg = registry if registry is not None else get_registry()
+        self.batching = BatchingBackend(
+            backend,
+            flush_ms=flush_ms,
+            expected_sessions=self.max_inflight,
+            registry=reg,
+        )
+        self._m_queue_depth = reg.gauge(
+            "serve_queue_depth", "Requests waiting in the admission queue.")
+        self._m_inflight = reg.gauge(
+            "serve_inflight", "Requests currently executing on workers.")
+        self._m_latency = reg.histogram(
+            "serve_request_latency_seconds",
+            "End-to-end request latency (submit -> terminal outcome), by "
+            "method and outcome (ok|timeout|failed).",
+            labels=("method", "outcome"),
+        )
+        self._m_accepted = reg.counter(
+            "serve_accepted_total", "Requests admitted to the queue.")
+        self._m_rejected = reg.counter(
+            "serve_rejected_total",
+            "Requests refused at admission, by reason "
+            "(queue_full|draining|stopped).",
+            labels=("reason",),
+        )
+        self._m_timeout = reg.counter(
+            "serve_timeout_total",
+            "Requests that hit their deadline (queued expiry, waiter "
+            "cancellation, or mid-retry expiry).")
+        self._m_retried = reg.counter(
+            "serve_retried_total",
+            "Transient-failure retries issued (attempts beyond the first).")
+        self._m_failed = reg.counter(
+            "serve_failed_total",
+            "Requests that terminally failed after exhausting retries.")
+
+        self._lock = threading.Lock()
+        self._work_cv = threading.Condition(self._lock)
+        self._idle_cv = threading.Condition(self._lock)
+        self._queue: Deque[Ticket] = collections.deque()
+        self._inflight_count = 0
+        self._draining = False
+        self._stopped = False
+        self._workers: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "RequestScheduler":
+        if self._workers:
+            raise RuntimeError("scheduler already started")
+        for i in range(self.max_inflight):
+            thread = threading.Thread(
+                target=self._worker_loop, name=f"serve-worker-{i}", daemon=True
+            )
+            thread.start()
+            self._workers.append(thread)
+        return self
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Close admission; with ``drain`` let queued + in-flight work
+        finish, otherwise fail queued tickets immediately.  Always joins
+        the workers — after return no ticket is unresolved."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with self._lock:
+            self._draining = True
+            if not drain:
+                while self._queue:
+                    ticket = self._queue.popleft()
+                    ticket._finish(
+                        "failed",
+                        error=SchedulerRejected(
+                            "stopped", "scheduler shut down before this "
+                            "request was scheduled"),
+                    )
+                    self._m_rejected.labels("stopped").inc()
+                self._m_queue_depth.set(0)
+            while self._queue or self._inflight_count:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                self._idle_cv.wait(timeout=remaining)
+            self._stopped = True
+            self._work_cv.notify_all()
+        for thread in self._workers:
+            join_for = None
+            if deadline is not None:
+                join_for = max(0.0, deadline - time.monotonic())
+            thread.join(timeout=join_for)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, request: Any,
+               timeout_s: Optional[float] = None) -> Ticket:
+        """Admit ``request`` or raise :class:`SchedulerRejected`.
+
+        ``timeout_s`` (or ``request.timeout_s``, or the server default)
+        becomes the ticket's deadline, measured from admission."""
+        if timeout_s is None:
+            timeout_s = getattr(request, "timeout_s", None)
+        if timeout_s is None:
+            timeout_s = self.default_timeout_s
+        deadline = (
+            time.monotonic() + float(timeout_s) if timeout_s is not None
+            else None
+        )
+        ticket = Ticket(request, deadline)
+        with self._lock:
+            if self._stopped or self._draining:
+                self._m_rejected.labels("draining").inc()
+                raise SchedulerRejected(
+                    "draining", "server is draining; not accepting requests")
+            if len(self._queue) >= self.max_queue_depth:
+                self._m_rejected.labels("queue_full").inc()
+                raise SchedulerRejected(
+                    "queue_full",
+                    f"admission queue is full "
+                    f"({self.max_queue_depth} waiting); retry later")
+            self._queue.append(ticket)
+            self._m_accepted.inc()
+            self._m_queue_depth.set(len(self._queue))
+            self._work_cv.notify()
+        return ticket
+
+    def stats(self) -> Dict[str, Any]:
+        """Live occupancy for /healthz."""
+        with self._lock:
+            return {
+                "queue_depth": len(self._queue),
+                "inflight": self._inflight_count,
+                "max_queue_depth": self.max_queue_depth,
+                "max_inflight": self.max_inflight,
+                "draining": self._draining,
+                "workers_alive": sum(t.is_alive() for t in self._workers),
+                "device_batches": dict(self.batching.batch_counts),
+            }
+
+    # -- workers -----------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            ticket = self._pop()
+            if ticket is None:
+                return
+            try:
+                self._run_ticket(ticket)
+            finally:
+                with self._lock:
+                    self._inflight_count -= 1
+                    self._m_inflight.set(self._inflight_count)
+                    if not self._queue and not self._inflight_count:
+                        self._idle_cv.notify_all()
+
+    def _pop(self) -> Optional[Ticket]:
+        with self._lock:
+            while not self._queue and not self._stopped:
+                self._work_cv.wait()
+            if not self._queue:
+                return None  # stopped and drained
+            ticket = self._queue.popleft()
+            self._m_queue_depth.set(len(self._queue))
+            self._inflight_count += 1
+            self._m_inflight.set(self._inflight_count)
+            return ticket
+
+    def _run_ticket(self, ticket: Ticket) -> None:
+        method = getattr(ticket.request, "method", "unknown")
+        if ticket.cancelled or ticket.expired():
+            # Died in the queue: the cheap overload outcome — no device
+            # work was wasted on it.
+            self._m_timeout.inc()
+            self._finish(ticket, method, "timeout",
+                         error=RequestTimeout("deadline expired in queue"))
+            return
+        while True:
+            ticket.attempts += 1
+            try:
+                with self.batching.session():
+                    value = self.handler(ticket.request, self.batching)
+            except Exception as exc:
+                if ticket.cancelled or ticket.expired():
+                    # The failure is moot: the deadline already passed, so
+                    # the terminal outcome is the timeout, not the error.
+                    self._m_timeout.inc()
+                    self._finish(ticket, method, "timeout",
+                                 error=RequestTimeout(
+                                     f"deadline expired during attempt "
+                                     f"{ticket.attempts} ({type(exc).__name__})"))
+                    return
+                if not self._should_retry(ticket, exc):
+                    self._m_failed.inc()
+                    logger.exception(
+                        "request %s failed terminally after %d attempt(s)",
+                        getattr(ticket.request, "request_id", ""),
+                        ticket.attempts,
+                    )
+                    self._finish(ticket, method, "failed", error=exc)
+                    return
+                self._m_retried.inc()
+                backoff = self.retry_backoff_s * (2 ** (ticket.attempts - 1))
+                remaining = ticket.remaining()
+                if remaining is not None:
+                    backoff = min(backoff, max(0.0, remaining))
+                time.sleep(backoff)
+                continue
+            if ticket.cancelled or ticket.expired():
+                # Completed past its deadline: the waiter is gone; report
+                # the truth (timeout) rather than a result nobody read.
+                self._m_timeout.inc()
+                self._finish(ticket, method, "timeout",
+                             error=RequestTimeout(
+                                 "completed after deadline; result discarded"))
+                return
+            self._finish(ticket, method, "ok", value=value)
+            return
+
+    def _should_retry(self, ticket: Ticket, exc: Exception) -> bool:
+        if not isinstance(exc, TRANSIENT_EXCEPTIONS):
+            return False
+        if ticket.attempts > self.max_retries:
+            return False
+        if ticket.cancelled or ticket.expired():
+            return False
+        return True
+
+    def _finish(self, ticket: Ticket, method: str, outcome: str,
+                value: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        self._m_latency.labels(method, outcome).observe(
+            time.monotonic() - ticket.submitted
+        )
+        ticket._finish(outcome, value=value, error=error)

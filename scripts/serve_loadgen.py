@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Open-loop load generator CLI for the consensus server.
+
+Replays AAMAS-scenario requests at a target rate and reports throughput,
+p50/p95/p99 latency, and rejection rate (one JSON object on stdout).
+
+Two modes:
+
+* ``--url http://host:port`` — drive an already-running server.
+* ``--self-contained`` — spin up an in-process fake-backend server (the
+  hardware-free smoke path), drive it, and shut it down; prints the same
+  report plus the server's device-batch accounting, which shows the
+  coalescing win (merged device batches << per-request call count).
+
+Examples:
+
+    python scripts/serve_loadgen.py --self-contained --requests 32 --rate 50
+    python scripts/serve_loadgen.py --url http://127.0.0.1:8080 \
+        --requests 100 --rate 10 --method best_of_n --params '{"n": 8}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--url", default=None,
+                        help="base URL of a running server")
+    parser.add_argument("--self-contained", action="store_true",
+                        help="start an in-process fake-backend server")
+    parser.add_argument("--requests", type=int, default=32)
+    parser.add_argument("--rate", type=float, default=20.0,
+                        help="offered load, requests/sec (open loop)")
+    parser.add_argument("--method", default="best_of_n")
+    parser.add_argument("--params", default='{"n": 4, "max_tokens": 24}',
+                        help="JSON object of method params")
+    parser.add_argument("--seed", type=int, default=100)
+    parser.add_argument("--evaluate", action="store_true",
+                        help="request per-agent utilities + welfare too")
+    parser.add_argument("--timeout-s", type=float, default=None,
+                        help="per-request deadline sent to the server")
+    parser.add_argument("--client-timeout-s", type=float, default=60.0)
+    parser.add_argument("--max-inflight", type=int, default=4,
+                        help="(self-contained) worker pool size")
+    parser.add_argument("--max-queue-depth", type=int, default=64,
+                        help="(self-contained) admission queue bound")
+    parser.add_argument("--metrics-out", default=None,
+                        help="write the serve-side registry snapshot delta "
+                             "(metrics.json schema) here (self-contained)")
+    args = parser.parse_args(argv)
+    if bool(args.url) == bool(args.self_contained):
+        parser.error("exactly one of --url / --self-contained is required")
+
+    from consensus_tpu.serve.loadgen import (
+        report_json,
+        run_loadgen,
+        scenario_requests,
+    )
+
+    payloads = scenario_requests(
+        args.requests,
+        method=args.method,
+        params=json.loads(args.params),
+        base_seed=args.seed,
+        evaluate=args.evaluate,
+        timeout_s=args.timeout_s,
+    )
+
+    if args.self_contained:
+        from consensus_tpu.obs import diff_snapshots, get_registry
+        from consensus_tpu.serve import create_server
+
+        server = create_server(
+            backend="fake",
+            port=0,  # ephemeral
+            max_inflight=args.max_inflight,
+            max_queue_depth=args.max_queue_depth,
+        ).start()
+        before = get_registry().snapshot()
+        try:
+            report = run_loadgen(
+                server.base_url, payloads, args.rate,
+                client_timeout_s=args.client_timeout_s,
+            )
+            report["device_batches"] = server.scheduler.stats()[
+                "device_batches"]
+        finally:
+            server.stop()
+        if args.metrics_out:
+            delta = diff_snapshots(before, get_registry().snapshot())
+            payload = {"schema": "consensus_tpu.metrics.v1",
+                       "metrics": delta}
+            pathlib.Path(args.metrics_out).write_text(
+                json.dumps(payload, indent=2))
+    else:
+        report = run_loadgen(
+            args.url, payloads, args.rate,
+            client_timeout_s=args.client_timeout_s,
+        )
+
+    print(report_json(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

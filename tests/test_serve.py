@@ -1,0 +1,605 @@
+"""Online serving subsystem tests (ISSUE 3).
+
+Three layers, all on FakeBackend (hardware-free, deterministic):
+
+* scheduler unit tests — admission rejection at capacity, deadline expiry,
+  retry-then-succeed, graceful drain with no orphaned tickets;
+* HTTP end-to-end — a real socket, ``POST /v1/consensus`` round-trip,
+  ``/healthz`` and ``/metrics`` schema, structured JSON errors;
+* the acceptance proof — N=16 concurrent open-loop clients against a
+  capacity-bounded server: every accepted statement byte-identical to the
+  same seeded request run serially through ``Experiment``, overload
+  explicitly rejected, and device-batch accounting showing concurrent
+  requests coalesced into fewer device calls than serial execution.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.obs.metrics import Registry
+from consensus_tpu.serve import (
+    ConsensusRequest,
+    ConsensusServer,
+    ConsensusService,
+    RequestScheduler,
+    RequestTimeout,
+    RequestValidationError,
+    SchedulerRejected,
+    create_server,
+    parse_request,
+)
+
+ISSUE = "Should we invest in public transport?"
+OPINIONS = {
+    "Agent 1": "Yes, buses and trains are vital public goods.",
+    "Agent 2": "Only alongside congestion pricing for cars.",
+    "Agent 3": "Prefer cycling infrastructure over big rail projects.",
+}
+PARAMS = {"n": 4, "max_tokens": 24}
+
+
+def _request(seed=7, **overrides):
+    payload = {
+        "issue": ISSUE,
+        "agent_opinions": OPINIONS,
+        "method": "best_of_n",
+        "params": dict(PARAMS),
+        "seed": seed,
+        "evaluate": False,
+    }
+    payload.update(overrides)
+    return parse_request(payload)
+
+
+def _post(base_url, payload, timeout=30.0):
+    """POST /v1/consensus; returns (status, decoded body)."""
+    request = urllib.request.Request(
+        base_url + "/v1/consensus",
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=timeout) as response:
+            return response.status, json.loads(response.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+class SlowCountingBackend:
+    """FakeBackend with a dispatch delay (forces request overlap so
+    coalescing is deterministic in tests) and device-batch counters."""
+
+    name = "slow-counting"
+
+    def __init__(self, delay_s=0.02):
+        self.inner = FakeBackend()
+        self.delay_s = delay_s
+        self.batches = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+
+    def _dispatch(self, kind, fn, requests):
+        self.batches[kind] += 1
+        time.sleep(self.delay_s)
+        return fn(requests)
+
+    def generate(self, requests):
+        return self._dispatch("generate", self.inner.generate, requests)
+
+    def score(self, requests):
+        return self._dispatch("score", self.inner.score, requests)
+
+    def next_token_logprobs(self, requests):
+        return self._dispatch(
+            "next_token", self.inner.next_token_logprobs, requests)
+
+    def embed(self, texts):
+        return self._dispatch("embed", self.inner.embed, texts)
+
+
+# ---------------------------------------------------------------------------
+# request validation
+# ---------------------------------------------------------------------------
+
+
+class TestParseRequest:
+    def test_valid_round_trip(self):
+        request = _request(seed=3, timeout_s=5, request_id="r-1")
+        assert isinstance(request, ConsensusRequest)
+        assert request.method == "best_of_n"
+        assert request.seed == 3
+        assert request.timeout_s == 5.0
+        assert request.request_id == "r-1"
+
+    def test_collects_every_error(self):
+        with pytest.raises(RequestValidationError) as excinfo:
+            parse_request({"issue": "", "agent_opinions": {},
+                           "method": "nope", "seed": "x", "bogus": 1})
+        errors = "\n".join(excinfo.value.errors)
+        assert "'issue'" in errors
+        assert "'agent_opinions'" in errors
+        assert "'method'" in errors
+        assert "'seed'" in errors
+        assert "bogus" in errors
+
+    def test_sweep_grid_params_rejected(self):
+        """List-valued params are an offline sweep axis (the
+        Experiment.expand_param_grid surface), not a single request."""
+        with pytest.raises(RequestValidationError) as excinfo:
+            _request(params={"n": [2, 4], "max_tokens": 24})
+        assert "sweep" in str(excinfo.value)
+
+    def test_non_object_body_rejected(self):
+        with pytest.raises(RequestValidationError):
+            parse_request([1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# scheduler units
+# ---------------------------------------------------------------------------
+
+
+def _scheduler(handler, registry=None, **kwargs):
+    kwargs.setdefault("max_queue_depth", 4)
+    kwargs.setdefault("max_inflight", 1)
+    kwargs.setdefault("default_timeout_s", 30.0)
+    kwargs.setdefault("retry_backoff_s", 0.001)
+    return RequestScheduler(
+        handler, FakeBackend(),
+        registry=registry if registry is not None else Registry(),
+        **kwargs,
+    )
+
+
+def _counter_total(registry, name):
+    family = registry.snapshot()["families"].get(name)
+    if not family:
+        return 0
+    return sum(s["value"] for s in family["series"])
+
+
+class TestSchedulerAdmission:
+    def test_rejects_at_capacity_with_explicit_reason(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def handler(request, backend):
+            entered.set()
+            release.wait(10.0)
+            return {"ok": True}
+
+        registry = Registry()
+        scheduler = _scheduler(
+            handler, registry, max_inflight=1, max_queue_depth=2).start()
+        try:
+            running = scheduler.submit(_request(0))
+            assert entered.wait(5.0)
+            queued = [scheduler.submit(_request(i)) for i in (1, 2)]
+            with pytest.raises(SchedulerRejected) as excinfo:
+                scheduler.submit(_request(3))
+            assert excinfo.value.reason == "queue_full"
+            assert _counter_total(registry, "serve_rejected_total") == 1
+            assert _counter_total(registry, "serve_accepted_total") == 3
+            release.set()
+            for ticket in [running] + queued:
+                assert ticket.wait(10.0)
+                assert ticket.result() == {"ok": True}
+        finally:
+            release.set()
+            scheduler.shutdown()
+
+    def test_draining_rejects_new_submissions(self):
+        scheduler = _scheduler(lambda r, b: {"ok": True}).start()
+        scheduler.shutdown(drain=True)
+        with pytest.raises(SchedulerRejected) as excinfo:
+            scheduler.submit(_request(0))
+        assert excinfo.value.reason == "draining"
+
+
+class TestSchedulerDeadlines:
+    def test_queued_request_expires_at_deadline(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def handler(request, backend):
+            entered.set()
+            release.wait(10.0)
+            return {"ok": True}
+
+        registry = Registry()
+        scheduler = _scheduler(handler, registry, max_inflight=1).start()
+        try:
+            blocker = scheduler.submit(_request(0))
+            assert entered.wait(5.0)
+            doomed = scheduler.submit(_request(1), timeout_s=0.05)
+            time.sleep(0.1)  # let the deadline lapse while queued
+            release.set()
+            assert doomed.wait(10.0)
+            assert doomed.outcome == "timeout"
+            with pytest.raises(RequestTimeout):
+                doomed.result()
+            assert blocker.wait(10.0) and blocker.outcome == "ok"
+            assert _counter_total(registry, "serve_timeout_total") == 1
+        finally:
+            release.set()
+            scheduler.shutdown()
+
+    def test_cancelled_ticket_reports_timeout(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def handler(request, backend):
+            entered.set()
+            release.wait(10.0)
+            return {"ok": True}
+
+        scheduler = _scheduler(handler, max_inflight=1).start()
+        try:
+            blocker = scheduler.submit(_request(0))
+            assert entered.wait(5.0)
+            abandoned = scheduler.submit(_request(1))
+            abandoned.cancel()  # waiter gave up before it was popped
+            release.set()
+            assert abandoned.wait(10.0)
+            assert abandoned.outcome == "timeout"
+            assert blocker.wait(10.0)
+        finally:
+            release.set()
+            scheduler.shutdown()
+
+
+class TestSchedulerRetries:
+    def test_transient_failure_retries_then_succeeds(self):
+        attempts = []
+
+        def handler(request, backend):
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("transient backend wobble")
+            return {"ok": True}
+
+        registry = Registry()
+        scheduler = _scheduler(handler, registry, max_retries=2).start()
+        try:
+            ticket = scheduler.submit(_request(0))
+            assert ticket.wait(10.0)
+            assert ticket.outcome == "ok"
+            assert ticket.result() == {"ok": True}
+            assert ticket.attempts == 3
+            assert _counter_total(registry, "serve_retried_total") == 2
+            assert _counter_total(registry, "serve_failed_total") == 0
+        finally:
+            scheduler.shutdown()
+
+    def test_retries_are_bounded(self):
+        def handler(request, backend):
+            raise RuntimeError("permanently transient-looking")
+
+        registry = Registry()
+        scheduler = _scheduler(handler, registry, max_retries=2).start()
+        try:
+            ticket = scheduler.submit(_request(0))
+            assert ticket.wait(10.0)
+            assert ticket.outcome == "failed"
+            assert ticket.attempts == 3  # 1 try + 2 retries
+            with pytest.raises(RuntimeError):
+                ticket.result()
+            assert _counter_total(registry, "serve_failed_total") == 1
+        finally:
+            scheduler.shutdown()
+
+    def test_validation_style_errors_never_retry(self):
+        attempts = []
+
+        def handler(request, backend):
+            attempts.append(1)
+            raise ValueError("bad method config")
+
+        scheduler = _scheduler(handler, max_retries=5).start()
+        try:
+            ticket = scheduler.submit(_request(0))
+            assert ticket.wait(10.0)
+            assert ticket.outcome == "failed"
+            assert len(attempts) == 1
+        finally:
+            scheduler.shutdown()
+
+
+class TestSchedulerDrain:
+    def test_drain_completes_everything_and_leaves_no_orphans(self):
+        def handler(request, backend):
+            time.sleep(0.01)
+            return {"seed": request.seed}
+
+        scheduler = _scheduler(
+            handler, max_inflight=2, max_queue_depth=16).start()
+        tickets = [scheduler.submit(_request(i)) for i in range(10)]
+        scheduler.shutdown(drain=True, timeout=30.0)
+        # Every ticket resolved with its own result — nothing orphaned.
+        for i, ticket in enumerate(tickets):
+            assert ticket.done()
+            assert ticket.result() == {"seed": i}
+        stats = scheduler.stats()
+        assert stats["queue_depth"] == 0
+        assert stats["inflight"] == 0
+        assert stats["workers_alive"] == 0
+
+    def test_non_drain_shutdown_fails_queued_tickets(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def handler(request, backend):
+            entered.set()
+            release.wait(10.0)
+            return {"ok": True}
+
+        scheduler = _scheduler(
+            handler, max_inflight=1, max_queue_depth=8).start()
+        running = scheduler.submit(_request(0))
+        assert entered.wait(5.0)
+        queued = [scheduler.submit(_request(i)) for i in (1, 2)]
+
+        def finish_soon():
+            time.sleep(0.05)
+            release.set()
+
+        threading.Thread(target=finish_soon, daemon=True).start()
+        scheduler.shutdown(drain=False, timeout=30.0)
+        # In-flight work completed; queued work failed fast and explicitly.
+        assert running.result() == {"ok": True}
+        for ticket in queued:
+            assert ticket.done()
+            with pytest.raises(SchedulerRejected):
+                ticket.result()
+
+
+class TestSchedulerCoalescing:
+    def test_concurrent_requests_share_device_batches(self):
+        """The scheduler's worker pool drives one shared BatchingBackend:
+        in-flight requests' generate/score calls merge into wider device
+        batches, so N requests cost far fewer than N× the solo dispatch
+        count — the whole point of putting a scheduler in front of the
+        batched engine."""
+        inner = SlowCountingBackend(delay_s=0.02)
+        service = ConsensusService(inner)
+        registry = Registry()
+        scheduler = RequestScheduler(
+            service.run, inner,
+            max_inflight=4, max_queue_depth=16,
+            registry=registry, flush_ms=50.0,
+        ).start()
+        try:
+            tickets = [scheduler.submit(_request(seed=100 + i))
+                       for i in range(8)]
+            for ticket in tickets:
+                assert ticket.wait(60.0)
+                assert ticket.outcome == "ok"
+        finally:
+            scheduler.shutdown()
+        # Serial execution = 8 generate + 8 score dispatches; merged must
+        # be strictly fewer on both kinds.
+        assert inner.batches["generate"] < 8
+        assert inner.batches["score"] < 8
+        # Per-kind completion wakeups stay surgical under mixed-kind load
+        # (ADVICE r5 item 4): nobody is woken while its request is pending.
+        assert _counter_total(
+            registry, "batching_spurious_wakeups_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# service determinism vs the offline Experiment harness
+# ---------------------------------------------------------------------------
+
+
+def _experiment_statements(tmp_path, seeds, scenario_issue, opinions):
+    """Serial (non-concurrent) Experiment runs: seed -> statement."""
+    from consensus_tpu.experiment import Experiment
+
+    config = {
+        "experiment_name": "serve_parity",
+        "output_dir": str(tmp_path / "exp"),
+        "scenario": {"issue": scenario_issue, "agent_opinions": opinions},
+        "methods_to_run": ["best_of_n"],
+        "best_of_n": dict(PARAMS),
+        "seed": seeds[0],
+        "num_seeds": len(seeds),
+        "concurrent_execution": False,
+    }
+    frame = Experiment(config, backend=FakeBackend()).run()
+    assert list(frame["seed"]) == list(seeds)
+    assert (frame["error_message"] == "").all()
+    return dict(zip(frame["seed"], frame["statement"]))
+
+
+class TestServiceDeterminism:
+    def test_service_matches_experiment(self, tmp_path):
+        expected = _experiment_statements(tmp_path, [7], ISSUE, OPINIONS)
+        service = ConsensusService(FakeBackend())
+        response = service.run(_request(seed=7))
+        assert response["statement"] == expected[7]
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end (real socket)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    instance = create_server(
+        backend=FakeBackend(), port=0, max_inflight=2, max_queue_depth=8,
+        registry=Registry(),
+    ).start()
+    yield instance
+    instance.stop()
+
+
+class TestHTTPEndToEnd:
+    def test_consensus_round_trip_matches_experiment(self, server, tmp_path):
+        expected = _experiment_statements(tmp_path, [11], ISSUE, OPINIONS)
+        status, body = _post(server.base_url, {
+            "issue": ISSUE, "agent_opinions": OPINIONS,
+            "method": "best_of_n", "params": PARAMS, "seed": 11,
+            "evaluate": True, "request_id": "e2e-1",
+        })
+        assert status == 200
+        assert body["statement"] == expected[11]
+        assert body["request_id"] == "e2e-1"
+        assert body["method"] == "best_of_n"
+        assert set(body["utilities"]) == set(OPINIONS)
+        for scores in body["utilities"].values():
+            assert {"cosine_similarity", "avg_logprob", "perplexity"} <= set(
+                scores)
+        assert "egalitarian_welfare_cosine" in body["welfare"]
+        assert body["generation_time_s"] >= 0
+
+    def test_healthz_schema(self, server):
+        with urllib.request.urlopen(server.base_url + "/healthz") as response:
+            assert response.status == 200
+            health = json.loads(response.read().decode())
+        assert health["status"] == "ok"
+        assert health["queue_depth"] == 0
+        assert health["inflight"] == 0
+        assert health["max_inflight"] == 2
+        assert health["max_queue_depth"] == 8
+        assert health["workers_alive"] == 2
+        assert health["backend"]["alive"] is True
+        assert set(health["device_batches"]) == {
+            "generate", "score", "next_token", "embed"}
+
+    def test_metrics_exposes_serve_families(self, server):
+        _post(server.base_url, {
+            "issue": ISSUE, "agent_opinions": OPINIONS,
+            "method": "best_of_n", "params": PARAMS, "seed": 1,
+            "evaluate": False,
+        })
+        with urllib.request.urlopen(server.base_url + "/metrics") as response:
+            assert response.status == 200
+            assert response.headers["Content-Type"].startswith("text/plain")
+            text = response.read().decode()
+        for family in (
+            "serve_queue_depth",
+            "serve_inflight",
+            "serve_request_latency_seconds",
+            "serve_accepted_total",
+        ):
+            assert family in text, family
+        assert 'outcome="ok"' in text
+
+    def test_validation_error_is_structured_json(self, server):
+        status, body = _post(server.base_url, {
+            "issue": "", "agent_opinions": {}, "method": "nope"})
+        assert status == 400
+        assert body["error"]["type"] == "validation"
+        assert any("'method'" in d for d in body["error"]["details"])
+
+    def test_bad_json_and_unknown_route(self, server):
+        request = urllib.request.Request(
+            server.base_url + "/v1/consensus", data=b"not json{",
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(request, timeout=10.0)
+        assert excinfo.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(server.base_url + "/nope", timeout=10.0)
+        assert excinfo.value.code == 404
+
+    def test_timeout_returns_504(self):
+        instance = create_server(
+            backend=SlowCountingBackend(delay_s=0.5), port=0,
+            max_inflight=1, registry=Registry(),
+        ).start()
+        try:
+            status, body = _post(instance.base_url, {
+                "issue": ISSUE, "agent_opinions": OPINIONS,
+                "method": "best_of_n", "params": PARAMS, "seed": 1,
+                "evaluate": False, "timeout_s": 0.05,
+            })
+            assert status == 504
+            assert body["error"]["type"] == "timeout"
+        finally:
+            instance.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance proof: 16 concurrent clients vs serial Experiment
+# ---------------------------------------------------------------------------
+
+
+class TestServingAcceptance:
+    def test_sixteen_concurrent_clients_capacity_bounded(self, tmp_path):
+        """ISSUE 3 acceptance: accepted responses byte-identical to serial
+        Experiment runs, overload explicitly rejected, and device-batch
+        accounting strictly below serial execution's dispatch count."""
+        from consensus_tpu.serve.loadgen import run_loadgen
+
+        n_clients = 16
+        seeds = list(range(500, 500 + n_clients))
+        expected = _experiment_statements(tmp_path, seeds, ISSUE, OPINIONS)
+
+        inner = SlowCountingBackend(delay_s=0.03)
+        registry = Registry()
+        instance = create_server(
+            backend=inner, port=0,
+            max_inflight=2, max_queue_depth=6,  # capacity-bounded: 16 > 2+6
+            registry=registry, flush_ms=100.0,
+        ).start()
+        payloads = [
+            {
+                "issue": ISSUE, "agent_opinions": OPINIONS,
+                "method": "best_of_n", "params": PARAMS,
+                "seed": seed, "evaluate": False,
+                "request_id": f"accept-{seed}",
+            }
+            for seed in seeds
+        ]
+        try:
+            report = run_loadgen(
+                instance.base_url, payloads, rate_rps=1000.0,
+                client_timeout_s=60.0,
+            )
+        finally:
+            instance.stop()
+
+        # Every client got a definite answer: a statement or a rejection.
+        assert report["completed"] + report["rejected"] == n_clients
+        assert report["failed"] == 0 and report["timeouts"] == 0
+        # Overload produced explicit rejections (16 arrivals vs 2 in
+        # flight + 6 queued), and plenty were still served.
+        assert report["rejected"] >= 1
+        assert report["completed"] >= 8
+        assert report["rejection_rate"] == pytest.approx(
+            report["rejected"] / n_clients)
+
+        # Byte-identical to the same seeded requests run serially through
+        # Experiment (per-request PRNG keys: batch composition is
+        # invisible to results).
+        for outcome in report["outcomes"]:
+            if outcome.status != 200:
+                continue
+            seed = int(outcome.request_id.split("-")[1])
+            assert outcome.statement == expected[seed], seed
+
+        # Coalescing: serial execution issues one generate + one score
+        # dispatch per statement; the shared BatchingBackend must do
+        # strictly better on both kinds.
+        completed = report["completed"]
+        assert inner.batches["generate"] < completed
+        assert inner.batches["score"] < completed
+
+        # The serve_* obs families recorded the run.
+        snapshot = registry.snapshot()["families"]
+        assert _counter_total(
+            registry, "serve_accepted_total") == completed
+        assert _counter_total(registry, "serve_rejected_total") == \
+            report["rejected"]
+        latency = snapshot["serve_request_latency_seconds"]["series"]
+        assert sum(s["count"] for s in latency) == completed
+        # Mixed-kind serving load keeps completion wakeups surgical.
+        assert _counter_total(
+            registry, "batching_spurious_wakeups_total") == 0
