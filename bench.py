@@ -249,6 +249,55 @@ def main() -> None:
             "serve_backend": "fake (subsystem cost, not device speed)",
         }
 
+    # ---- chaos cell: the serve stack under a transient-fault plan ----
+    # Same fixed-rate open-loop workload, but the fake engine sits under
+    # supervisor(faults(engine)) with a 5% seeded transient-fault plan:
+    # what fraction of requests still succeed, what the fault retries do
+    # to tail latency, and how many retries the stack absorbed per
+    # request.  BENCH_CHAOS=0 skips; BENCH_CHAOS_RATE_FAULTS rescales the
+    # injected fault rate.
+    chaos_extra = {}
+    if os.environ.get("BENCH_CHAOS", "1") != "0":
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+
+        chaos_requests = int(os.environ.get("BENCH_CHAOS_REQUESTS", "32"))
+        chaos_rate = float(os.environ.get("BENCH_CHAOS_RATE", "50"))
+        chaos_fault_rate = float(
+            os.environ.get("BENCH_CHAOS_RATE_FAULTS", "0.05"))
+        chaos_plan = {"seed": 7, "faults": [
+            {"kind": "transient_error", "op": "*", "rate": chaos_fault_rate}]}
+        chaos_before = get_registry().snapshot()
+        server = create_server(
+            backend="fake", port=0, max_inflight=4, fault_plan=chaos_plan,
+        ).start()
+        try:
+            chaos_report = run_loadgen(
+                server.base_url,
+                scenario_requests(chaos_requests, params={
+                    "n": 8, "max_tokens": NEW_TOKENS}),
+                rate_rps=chaos_rate,
+            )
+        finally:
+            server.stop()
+        chaos_delta = diff_snapshots(chaos_before, get_registry().snapshot())
+
+        def _family_total(name: str) -> float:
+            family = (chaos_delta.get("families") or {}).get(name) or {}
+            return sum(s.get("value", 0) for s in family.get("series", []))
+
+        chaos_retries = _family_total("supervisor_retries_total") \
+            + _family_total("serve_retried_total")
+        chaos_extra = {
+            "chaos_success_frac": chaos_report["availability"],
+            "chaos_p99_ms": chaos_report["latency_ms"]["p99"],
+            "chaos_retries_per_request": round(
+                chaos_retries / chaos_requests, 4) if chaos_requests else 0.0,
+            "chaos_fault_rate": chaos_fault_rate,
+            "chaos_faults_injected": _family_total("faults_injected_total"),
+            "chaos_requests": chaos_requests,
+        }
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -354,6 +403,7 @@ def main() -> None:
                     ),
                     **mcts_extra,
                     **serve_extra,
+                    **chaos_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

@@ -12,12 +12,17 @@ from typing import Any, Dict, Optional
 from consensus_tpu.backends.base import (  # noqa: F401
     BAN_BIAS,
     Backend,
+    BackendError,
+    BackendIntegrityError,
+    BackendLostError,
     GenerationRequest,
     GenerationResult,
     NextTokenRequest,
+    PartialBatchError,
     ScoreRequest,
     ScoreResult,
     TokenCandidate,
+    TransientBackendError,
     generate_one,
     score_one,
 )
@@ -81,3 +86,37 @@ def get_backend(spec: Optional[Any] = None, **kwargs) -> Backend:
 
 def clear_backend_cache() -> None:
     _BACKEND_CACHE.clear()
+
+
+def wrap_backend(
+    backend: Backend,
+    fault_plan=None,
+    supervise=None,
+    registry=None,
+) -> Backend:
+    """Layer the fault-tolerance wrappers onto a resolved backend.
+
+    Order matters: faults are injected BELOW supervision so the supervisor
+    has to handle them — ``supervisor(faults(engine))`` is the chaos-test
+    stack.  Wrapped instances are never cached (``get_backend``'s cache
+    holds only raw engines, so a faulted backend can't leak into a clean
+    run).
+
+    ``fault_plan``: a :class:`~consensus_tpu.backends.faults.FaultPlan`,
+    dict, or JSON string; ``supervise``: ``True`` for defaults or a dict of
+    :class:`~consensus_tpu.backends.supervisor.SupervisedBackend` kwargs.
+    A fault plan without explicit ``supervise=False`` implies supervision —
+    injecting faults nothing handles just breaks the run.
+    """
+    if fault_plan is not None:
+        from consensus_tpu.backends.faults import FaultInjectingBackend
+
+        backend = FaultInjectingBackend(backend, fault_plan, registry=registry)
+        if supervise is None:
+            supervise = True
+    if supervise:
+        from consensus_tpu.backends.supervisor import SupervisedBackend
+
+        options = dict(supervise) if isinstance(supervise, dict) else {}
+        backend = SupervisedBackend(backend, registry=registry, **options)
+    return backend

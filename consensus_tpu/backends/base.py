@@ -28,6 +28,55 @@ import numpy as np
 BAN_BIAS = -1.0e6
 
 
+# -- error taxonomy ----------------------------------------------------------
+#
+# Raw backends raise whatever their transport raises (RuntimeError from XLA,
+# TimeoutError/OSError from sockets).  The supervision layer
+# (backends/supervisor.py) classifies those into this typed hierarchy so
+# every caller above the backend seam — batching, the experiment harness,
+# the serving scheduler — can decide retry-vs-fail-vs-isolate by type
+# instead of by string matching.
+
+
+class BackendError(Exception):
+    """Base of the typed backend failure taxonomy (docs/ARCHITECTURE.md
+    §Fault tolerance)."""
+
+
+class TransientBackendError(BackendError):
+    """A retryable failure (flaky dispatch, timeout, dropped connection):
+    the same call MAY succeed if reissued.  Raised by the supervisor after
+    its own bounded retry budget is exhausted — seeing this type means
+    retrying already happened below you."""
+
+
+class BackendIntegrityError(BackendError):
+    """The backend returned, but the payload is poisoned (NaN/Inf logprobs,
+    a deterministically-failing row).  Never retryable: the same input
+    produces the same poison."""
+
+
+class BackendLostError(BackendError):
+    """The device/backend is gone for good (or fenced off by an open
+    circuit breaker).  Not retryable within this process."""
+
+
+class PartialBatchError(BackendError):
+    """Some rows of a batched call failed and the rest succeeded.
+
+    ``results`` is the full-length result list (or array) with valid
+    entries at surviving indices; ``row_errors`` maps failing row index →
+    the typed error for that row.  ``BatchingBackend`` unpacks this so one
+    poisoned row fails only the session that submitted it; direct callers
+    can either treat it as a whole-call failure or pick out ``results``.
+    """
+
+    def __init__(self, message: str, results, row_errors):
+        super().__init__(message)
+        self.results = results
+        self.row_errors = dict(row_errors)
+
+
 @dataclasses.dataclass(frozen=True)
 class GenerationRequest:
     """One text-generation work item.

@@ -34,6 +34,7 @@ from consensus_tpu.backends.base import (
     GenerationRequest,
     GenerationResult,
     NextTokenRequest,
+    PartialBatchError,
     ScoreRequest,
     ScoreResult,
     TokenCandidate,
@@ -100,6 +101,13 @@ class BatchingBackend:
             "Batch flushes by trigger: all active sessions blocked vs. "
             "flush_ms quiescence timeout.",
             labels=("kind", "reason"),
+        )
+        self._row_errors = reg.counter(
+            "batching_row_errors_total",
+            "Rows of a merged device batch that failed with a typed "
+            "per-row error while sibling rows succeeded (PartialBatchError "
+            "unpacking; poison-row isolation).",
+            labels=("kind",),
         )
         self._spurious_wakeups = reg.counter(
             "batching_spurious_wakeups_total",
@@ -382,6 +390,12 @@ class BatchingBackend:
                         entry.result = list(results[cursor : cursor + n])
                     cursor += n
                     entry.done = True
+            except PartialBatchError as exc:
+                # Typed per-row propagation (supervisor poison isolation):
+                # a waiter whose rows all survived gets its slice; a waiter
+                # owning a failed row gets that row's typed error — one bad
+                # row fails one session's call, not the whole device batch.
+                self._distribute_partial(kind, queue, exc)
             except Exception as exc:  # fail every waiter in this batch
                 for entry in queue:
                     entry.error = exc
@@ -400,3 +414,40 @@ class BatchingBackend:
             cond = self._dispatch_conds[kind]
             with cond:
                 cond.notify_all()
+
+    def _distribute_partial(
+        self, kind: str, queue: List[_Pending], exc: PartialBatchError
+    ) -> None:
+        """Slice a PartialBatchError back onto its waiters.
+
+        Entries with only surviving rows get their result slice
+        (bit-identical to a clean batch: per-request PRNG keys).  Entries
+        owning failed rows get the typed row error — the single-row error
+        itself when the whole slice failed, or a per-entry
+        PartialBatchError when the entry mixes good and bad rows."""
+        cursor = 0
+        for entry in queue:
+            n = len(entry.requests)
+            slice_errors = {
+                i - cursor: err
+                for i, err in exc.row_errors.items()
+                if cursor <= i < cursor + n
+            }
+            if not slice_errors:
+                if kind == "embed":
+                    entry.result = np.asarray(exc.results[cursor : cursor + n])
+                else:
+                    entry.result = list(exc.results[cursor : cursor + n])
+            else:
+                self._row_errors.labels(kind).inc(len(slice_errors))
+                if len(slice_errors) == n:
+                    entry.error = next(iter(slice_errors.values()))
+                else:
+                    entry.error = PartialBatchError(
+                        f"{len(slice_errors)}/{n} rows of this session's "
+                        f"{kind} call failed inside a merged device batch",
+                        results=list(exc.results[cursor : cursor + n]),
+                        row_errors=slice_errors,
+                    )
+            cursor += n
+            entry.done = True

@@ -1,0 +1,299 @@
+"""Deterministic fault injection: the test substrate for every failure path.
+
+Nothing in a failure path can be trusted until it has been exercised, and
+real devices fail unreproducibly.  :class:`FaultInjectingBackend` wraps any
+:class:`~consensus_tpu.backends.base.Backend` and injects faults from a
+seeded :class:`FaultPlan` — the SAME plan against the same workload injects
+the SAME faults at the same call indices, so chaos tests are as
+reproducible as golden tests.
+
+Fault kinds (``FaultSpec.kind``):
+
+* ``transient_error`` / ``timeout_error`` — raise ``RuntimeError`` /
+  ``TimeoutError`` BEFORE the inner call (the raw exception types flaky
+  transports actually raise; the supervisor must classify them).
+* ``nan_logprobs`` / ``inf_logprobs`` — poison one row (or all rows) of a
+  ``score`` / ``next_token_logprobs`` result with NaN / +Inf.
+* ``truncate`` — cut a generation's text in half and mark it
+  ``finish_reason="length"``.
+* ``latency`` — sleep ``latency_s`` before the inner call.
+* ``device_lost`` — from the firing call onward, EVERY call raises
+  :class:`~consensus_tpu.backends.base.BackendLostError` (a preempted TPU
+  does not come back).
+
+Firing is per-op and per-call-index: ``call_index`` pins a spec to the
+N-th call of that op (exact), ``rate`` fires pseudo-randomly via a seeded
+hash of ``(plan seed, spec index, op, call index)`` — deterministic given
+the call order.  Injections are counted in ``faults_injected_total{kind,op}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from consensus_tpu.backends.base import (
+    Backend,
+    BackendLostError,
+    GenerationRequest,
+    GenerationResult,
+    NextTokenRequest,
+    ScoreRequest,
+    ScoreResult,
+    TokenCandidate,
+)
+from consensus_tpu.obs.metrics import Registry, get_registry
+
+#: Ops fault specs can target (``"*"`` matches all of them).
+OPS = ("generate", "score", "next_token", "embed")
+
+FAULT_KINDS = (
+    "transient_error",
+    "timeout_error",
+    "nan_logprobs",
+    "inf_logprobs",
+    "truncate",
+    "latency",
+    "device_lost",
+)
+
+
+def _hash_unit(*parts) -> float:
+    """Deterministic float in [0, 1) from the fault plan's hash space."""
+    h = hashlib.blake2b(digest_size=8)
+    for part in parts:
+        h.update(str(part).encode("utf-8", "replace"))
+        h.update(b"\x1f")
+    return int.from_bytes(h.digest(), "big") / 2**64
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault rule: what to inject, into which op, and when."""
+
+    kind: str
+    op: str = "*"  # generate | score | next_token | embed | *
+    #: Exact per-op call index to fire at (0-based).  Mutually exclusive
+    #: with ``rate`` in spirit; when set, ``rate`` is ignored.
+    call_index: Optional[int] = None
+    #: Seeded per-call firing probability when ``call_index`` is None.
+    rate: float = 0.0
+    #: Row to poison for nan/inf/truncate faults (None = every row).
+    row_index: Optional[int] = None
+    #: Added delay for ``latency`` faults.
+    latency_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; expected one of "
+                f"{FAULT_KINDS}"
+            )
+        if self.op != "*" and self.op not in OPS:
+            raise ValueError(f"unknown op {self.op!r}; expected {OPS} or '*'")
+        if not 0.0 <= self.rate <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {self.rate}")
+
+    def matches(self, op: str) -> bool:
+        return self.op == "*" or self.op == op
+
+    def fires(self, seed: int, spec_index: int, op: str, call_index: int) -> bool:
+        if not self.matches(op):
+            return False
+        if self.call_index is not None:
+            return call_index == self.call_index
+        if self.rate <= 0.0:
+            return False
+        return _hash_unit(seed, spec_index, op, call_index) < self.rate
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A seeded, ordered set of :class:`FaultSpec` rules."""
+
+    seed: int = 0
+    faults: Tuple[FaultSpec, ...] = ()
+
+    @classmethod
+    def from_spec(cls, spec: Union["FaultPlan", Dict[str, Any], str, None]
+                  ) -> Optional["FaultPlan"]:
+        """Coerce a plan from itself, a dict, or a JSON string (the
+        ``--fault-plan`` CLI surface); ``None`` stays ``None``."""
+        if spec is None or isinstance(spec, FaultPlan):
+            return spec
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"fault plan must be a dict or JSON object, got "
+                f"{type(spec).__name__}"
+            )
+        faults = tuple(
+            fault if isinstance(fault, FaultSpec) else FaultSpec(**fault)
+            for fault in spec.get("faults", ())
+        )
+        return cls(seed=int(spec.get("seed", 0)), faults=faults)
+
+    def firing(self, op: str, call_index: int) -> List[FaultSpec]:
+        """Specs that fire for this (op, per-op call index)."""
+        return [
+            spec for i, spec in enumerate(self.faults)
+            if spec.fires(self.seed, i, op, call_index)
+        ]
+
+
+class FaultInjectingBackend:
+    """Wrap ``inner`` and inject the plan's faults into its protocol calls.
+
+    Deliberately does NOT expose ``open_fused_token_search``: fused
+    sessions bypass the protocol seam, so they would bypass injection too —
+    without the attribute, the session factory falls back to the
+    full-prefix path whose every call crosses this wrapper.
+    """
+
+    name = "faults"
+
+    def __init__(
+        self,
+        inner: Backend,
+        plan: Union[FaultPlan, Dict[str, Any], str],
+        registry: Optional[Registry] = None,
+        sleep=time.sleep,
+    ):
+        self.inner = inner
+        self.plan = FaultPlan.from_spec(plan) or FaultPlan()
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._call_index = {op: 0 for op in OPS}
+        self._device_lost = False
+        reg = registry if registry is not None else get_registry()
+        self._injected = reg.counter(
+            "faults_injected_total",
+            "Faults injected by the fault-injection backend, by kind and op.",
+            labels=("kind", "op"),
+        )
+
+    # -- passthrough surface -------------------------------------------------
+
+    @property
+    def deterministic_greedy(self) -> bool:
+        return bool(getattr(self.inner, "deterministic_greedy", False))
+
+    @property
+    def token_counts(self):
+        return getattr(self.inner, "token_counts", {})
+
+    # -- injection core ------------------------------------------------------
+
+    def _next_index(self, op: str) -> int:
+        with self._lock:
+            index = self._call_index[op]
+            self._call_index[op] = index + 1
+            return index
+
+    def _pre_call(self, op: str) -> List[FaultSpec]:
+        """Apply call-blocking faults; return result-mutating specs."""
+        index = self._next_index(op)
+        specs = self.plan.firing(op, index)
+        if self._device_lost or any(s.kind == "device_lost" for s in specs):
+            if not self._device_lost:
+                self._injected.labels("device_lost", op).inc()
+            self._device_lost = True
+            raise BackendLostError(
+                f"injected device loss (op={op}, call={index})"
+            )
+        post = []
+        for spec in specs:
+            if spec.kind == "latency":
+                self._injected.labels("latency", op).inc()
+                self._sleep(spec.latency_s)
+            elif spec.kind == "transient_error":
+                self._injected.labels("transient_error", op).inc()
+                raise RuntimeError(
+                    f"injected transient fault (op={op}, call={index})"
+                )
+            elif spec.kind == "timeout_error":
+                self._injected.labels("timeout_error", op).inc()
+                raise TimeoutError(
+                    f"injected timeout (op={op}, call={index})"
+                )
+            else:
+                post.append(spec)
+        return post
+
+    def _target_rows(self, spec: FaultSpec, n: int) -> List[int]:
+        if spec.row_index is None:
+            return list(range(n))
+        return [spec.row_index] if 0 <= spec.row_index < n else []
+
+    # -- protocol ------------------------------------------------------------
+
+    def generate(self, requests: Sequence[GenerationRequest]) -> List[GenerationResult]:
+        post = self._pre_call("generate")
+        results = list(self.inner.generate(requests))
+        for spec in post:
+            if spec.kind != "truncate":
+                continue
+            for row in self._target_rows(spec, len(results)):
+                res = results[row]
+                cut = max(1, len(res.text) // 2)
+                results[row] = dataclasses.replace(
+                    res, text=res.text[:cut], finish_reason="length"
+                )
+                self._injected.labels("truncate", "generate").inc()
+        return results
+
+    def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+        post = self._pre_call("score")
+        results = list(self.inner.score(requests))
+        for spec in post:
+            if spec.kind not in ("nan_logprobs", "inf_logprobs"):
+                continue
+            poison = float("nan") if spec.kind == "nan_logprobs" else float("inf")
+            for row in self._target_rows(spec, len(results)):
+                res = results[row]
+                logprobs = list(res.logprobs) or [0.0]
+                logprobs[0] = poison
+                results[row] = dataclasses.replace(
+                    res,
+                    tokens=res.tokens or ("<poison>",),
+                    logprobs=tuple(logprobs),
+                )
+                self._injected.labels(spec.kind, "score").inc()
+        return results
+
+    def next_token_logprobs(
+        self, requests: Sequence[NextTokenRequest]
+    ) -> List[List[TokenCandidate]]:
+        post = self._pre_call("next_token")
+        results = [list(cands) for cands in self.inner.next_token_logprobs(requests)]
+        for spec in post:
+            if spec.kind not in ("nan_logprobs", "inf_logprobs"):
+                continue
+            poison = float("nan") if spec.kind == "nan_logprobs" else float("inf")
+            for row in self._target_rows(spec, len(results)):
+                cands = results[row]
+                if cands:
+                    results[row] = [
+                        dataclasses.replace(cands[0], logprob=poison)
+                    ] + cands[1:]
+                    self._injected.labels(spec.kind, "next_token").inc()
+        return results
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        post = self._pre_call("embed")
+        vectors = np.array(self.inner.embed(texts), copy=True)
+        for spec in post:
+            if spec.kind not in ("nan_logprobs", "inf_logprobs"):
+                continue
+            poison = float("nan") if spec.kind == "nan_logprobs" else float("inf")
+            for row in self._target_rows(spec, len(vectors)):
+                vectors[row, 0] = poison
+                self._injected.labels(spec.kind, "embed").inc()
+        return vectors

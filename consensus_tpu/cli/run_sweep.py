@@ -80,6 +80,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="write a sweep-level metrics aggregate (merge of every cell's "
         "metrics.json delta) to this path",
     )
+    parser.add_argument(
+        "--resume", action="store_true",
+        help="resume each cell from its newest journaled run dir: rows "
+        "already in journal.jsonl are reused, only missing (method, "
+        "config, seed) combos execute; the merged results.csv is "
+        "byte-identical to an uninterrupted run",
+    )
+    parser.add_argument(
+        "--on-error", choices=["fail", "skip", "retry"], default=None,
+        help="per-row failure policy: 'skip' records a structured error "
+        "row and continues (default), 'retry' retries the row before "
+        "recording the error, 'fail' aborts the cell (journaled rows "
+        "remain resumable)",
+    )
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args(argv)
 
@@ -96,6 +110,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         overrides["timing_pin_budget"] = True
     if args.profile_dir:
         overrides["profile_dir"] = args.profile_dir
+    if args.resume:
+        overrides["resume"] = True
+    if args.on_error:
+        overrides["on_error"] = args.on_error
 
     logger.info("Running %d configs", len(configs))
     failures = 0
@@ -139,6 +157,7 @@ def write_sweep_metrics(
         merge_snapshots,
         padding_efficiency,
     )
+    from consensus_tpu.utils.io_atomic import atomic_write_json
 
     cells = []
     for run_dir in cell_dirs:
@@ -163,8 +182,7 @@ def write_sweep_metrics(
             name: payload.get("spans", []) for name, payload in cells
         },
     }
-    out_path.parent.mkdir(parents=True, exist_ok=True)
-    out_path.write_text(json.dumps(aggregate, indent=2))
+    atomic_write_json(out_path, aggregate)
     logger.info("Sweep metrics aggregate -> %s", out_path)
     return aggregate
 

@@ -29,17 +29,20 @@ PRNG keys keep results bit-identical to sequential execution.
 
 from __future__ import annotations
 
+import collections
 import datetime
+import hashlib
 import itertools
+import json
 import logging
 import pathlib
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import pandas as pd
 import yaml
 
-from consensus_tpu.backends import get_backend
+from consensus_tpu.backends import get_backend, wrap_backend
 from consensus_tpu.backends.base import Backend
 from consensus_tpu.methods import get_method_generator
 from consensus_tpu.obs import (
@@ -48,6 +51,12 @@ from consensus_tpu.obs import (
     diff_span_paths,
     get_registry,
     padding_efficiency,
+)
+from consensus_tpu.utils.io_atomic import (
+    JournalWriter,
+    atomic_write_json,
+    atomic_write_text,
+    read_journal,
 )
 from consensus_tpu.utils.tracing import device_trace, get_tracer
 
@@ -63,6 +72,18 @@ _LEAD_COLUMNS = [
     "error_message",
     "evaluation_status",
 ]
+
+#: ``on_error`` policies for a failed (method, config, seed) run.
+ON_ERROR_POLICIES = ("skip", "fail", "retry")
+
+
+def run_config_hash(run_config: Dict[str, Any]) -> str:
+    """Stable short hash of a run config (seed excluded — the journal key
+    carries the seed separately, so the same grid point across seeds shares
+    one hash)."""
+    payload = {k: v for k, v in run_config.items() if k != "seed"}
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.blake2b(blob.encode("utf-8"), digest_size=8).hexdigest()
 
 
 class Experiment:
@@ -96,13 +117,52 @@ class Experiment:
                 options["pin_generation_budget"] = True
             self.backend = get_backend(config.get("backend", "fake"), **options)
 
+        # Fault-tolerance stack: supervisor(faults(engine)).  ``fault_plan``
+        # (chaos runs) implies supervision unless explicitly disabled.
+        fault_plan = config.get("fault_plan")
+        supervise = config.get("supervisor")
+        if fault_plan is not None or supervise:
+            self.backend = wrap_backend(
+                self.backend, fault_plan=fault_plan, supervise=supervise
+            )
+
+        self.on_error = str(config.get("on_error", "skip"))
+        if self.on_error not in ON_ERROR_POLICIES:
+            raise ValueError(
+                f"on_error must be one of {ON_ERROR_POLICIES}, "
+                f"got {self.on_error!r}"
+            )
+        self.error_retries = max(0, int(config.get("error_retries", 1)))
+        #: Zero wall-clock columns so chaos/resume proofs can assert
+        #: byte-identical results.csv across runs.
+        self.deterministic_artifacts = bool(
+            config.get("deterministic_artifacts", False)
+        )
+
         output_dir = pathlib.Path(config.get("output_dir", "results"))
         name = config.get("experiment_name", "experiment")
-        stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
-        self.run_dir = output_dir / f"{name}_{stamp}"
+        self.resume = bool(config.get("resume", False))
+        run_dir: Optional[pathlib.Path] = None
+        if self.resume:
+            # Reuse the newest journaled run dir for this experiment name;
+            # timestamped names sort chronologically.
+            candidates = sorted(
+                p for p in output_dir.glob(f"{name}_*")
+                if (p / "journal.jsonl").exists()
+            )
+            if candidates:
+                run_dir = candidates[-1]
+                logger.info("Resuming from %s", run_dir)
+        self.resumed = run_dir is not None
+        if run_dir is None:
+            stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+            run_dir = output_dir / f"{name}_{stamp}"
+        self.run_dir = run_dir
         self.run_dir.mkdir(parents=True, exist_ok=True)
-        with open(self.run_dir / "config.yaml", "w") as fh:
-            yaml.safe_dump(config, fh, sort_keys=False)
+        atomic_write_text(
+            self.run_dir / "config.yaml",
+            yaml.safe_dump(config, sort_keys=False),
+        )
         logger.info("Run directory: %s", self.run_dir)
 
     # -- run configs ---------------------------------------------------------
@@ -147,41 +207,106 @@ class Experiment:
         seed: int,
         backend: Optional[Backend] = None,
     ) -> Dict:
-        row: Dict[str, Any] = {
-            "method": method,
-            "seed": seed,
-            "error_message": "",
-            "evaluation_status": "pending",
-        }
-        for key, value in run_config.items():
-            if key != "seed":
-                row[f"param_{key}"] = value
+        attempts = 1 + (self.error_retries if self.on_error == "retry" else 0)
         start = time.perf_counter()
-        try:
-            generator = get_method_generator(
-                method, backend or self.backend, run_config, self.generation_model
-            )
-            with get_tracer().span(f"generate/{method}"):
-                statement = generator.generate_statement(
-                    self.issue, self.agent_opinions
+        for attempt in range(attempts):
+            row: Dict[str, Any] = {
+                "method": method,
+                "seed": seed,
+                "error_message": "",
+                "evaluation_status": "pending",
+            }
+            for key, value in run_config.items():
+                if key != "seed":
+                    row[f"param_{key}"] = value
+            try:
+                generator = get_method_generator(
+                    method, backend or self.backend, run_config,
+                    self.generation_model,
                 )
-            row["statement"] = statement
-            if generator.pre_brushup_statement is not None and run_config.get(
-                "brushup", False
-            ):
-                row["pre_brushup_statement"] = generator.pre_brushup_statement
-        except Exception as exc:  # error row, sweep continues (reference :194-201)
-            logger.exception("Method %s failed", method)
-            row["statement"] = ""
-            row["error_message"] = f"{type(exc).__name__}: {exc}"
-        row["generation_time_s"] = round(time.perf_counter() - start, 3)
+                with get_tracer().span(f"generate/{method}"):
+                    statement = generator.generate_statement(
+                        self.issue, self.agent_opinions
+                    )
+                row["statement"] = statement
+                if generator.pre_brushup_statement is not None and run_config.get(
+                    "brushup", False
+                ):
+                    row["pre_brushup_statement"] = generator.pre_brushup_statement
+                break
+            except Exception as exc:
+                if self.on_error == "fail":
+                    raise
+                if attempt + 1 < attempts:
+                    logger.warning(
+                        "Method %s failed (%s: %s); retry %d/%d",
+                        method, type(exc).__name__, exc,
+                        attempt + 1, attempts - 1,
+                    )
+                    continue
+                # Structured error row, sweep continues (reference :194-201).
+                logger.exception("Method %s failed", method)
+                row["statement"] = ""
+                row["error_message"] = f"{type(exc).__name__}: {exc}"
+        row["generation_time_s"] = (
+            0.0 if self.deterministic_artifacts
+            else round(time.perf_counter() - start, 3)
+        )
         return row
+
+    @staticmethod
+    def _journal_key(run: Dict[str, Any]) -> Tuple[str, str, int]:
+        return (
+            str(run["method"]),
+            run_config_hash(run["config"]),
+            int(run["seed"]),
+        )
+
+    def _load_journal(
+        self, runs: List[Dict[str, Any]]
+    ) -> Dict[int, Dict[str, Any]]:
+        """Map journaled rows back onto the deterministic run list.
+
+        Keys ``(method, config_hash, seed)`` can repeat (identical grid
+        points are legal), so matching is by multiplicity: the K-th
+        journaled row for a key fills the K-th run with that key."""
+        if not self.resumed:
+            return {}
+        journaled: Dict[Tuple[str, str, int], collections.deque] = {}
+        for record in read_journal(self.run_dir / "journal.jsonl"):
+            key_info = record.get("key") or {}
+            row = record.get("row")
+            if not isinstance(row, dict):
+                continue
+            key = (
+                str(key_info.get("method", "")),
+                str(key_info.get("config_hash", "")),
+                int(key_info.get("seed", -1)),
+            )
+            journaled.setdefault(key, collections.deque()).append(row)
+        done: Dict[int, Dict[str, Any]] = {}
+        for index, run in enumerate(runs):
+            queue = journaled.get(self._journal_key(run))
+            if queue:
+                done[index] = queue.popleft()
+        return done
 
     def run(self) -> pd.DataFrame:
         runs: List[Dict[str, Any]] = []
         for i in range(self.num_seeds):
             seed = self.base_seed + i
             runs.extend(self._run_configs(seed))
+
+        rows_by_index = self._load_journal(runs)
+        pending = [
+            (index, run) for index, run in enumerate(runs)
+            if index not in rows_by_index
+        ]
+        if rows_by_index:
+            logger.info(
+                "Resume: %d/%d rows journaled; executing %d",
+                len(rows_by_index), len(runs), len(pending),
+            )
 
         # Token-honest cell accounting: the backend may be shared across an
         # in-process sweep, so record deltas around this experiment's runs.
@@ -203,55 +328,84 @@ class Experiment:
         if profile_dir:
             profile_dir = str(pathlib.Path(profile_dir) / self.run_dir.name)
 
-        with tracer.span("experiment"), device_trace(profile_dir):
-            # Worker threads adopt this path so their generate/<method>
-            # spans nest under this experiment in the span tree.
-            parent_path = tracer.current_path()
-            if concurrent and len(runs) > 1 and max_workers > 1:
-                # Independent combos (all seeds flattened) share device
-                # batches through the BatchingBackend; results stay
-                # bit-identical to sequential execution (per-request PRNG
-                # keys).
-                from concurrent.futures import ThreadPoolExecutor
+        # Each completed row is journaled (append + fsync) BEFORE the sweep
+        # moves on, so a kill at any point loses at most the in-flight rows;
+        # --resume replays the journal and executes only what's missing.
+        journal = JournalWriter(self.run_dir / "journal.jsonl")
 
-                from consensus_tpu.backends.batching import BatchingBackend
+        def finish(index: int, run: Dict[str, Any], row: Dict[str, Any]) -> Dict[str, Any]:
+            method, config_hash, seed = self._journal_key(run)
+            journal.append({
+                "key": {
+                    "method": method,
+                    "config_hash": config_hash,
+                    "seed": seed,
+                },
+                "run_index": index,
+                "row": row,
+            })
+            return row
 
-                batching = BatchingBackend(
-                    self.backend,
-                    flush_ms=float(self.config.get("batch_flush_ms", 10.0)),
-                    expected_sessions=min(max_workers, len(runs)),
-                )
+        try:
+            with tracer.span("experiment"), device_trace(profile_dir):
+                # Worker threads adopt this path so their generate/<method>
+                # spans nest under this experiment in the span tree.
+                parent_path = tracer.current_path()
+                if concurrent and len(pending) > 1 and max_workers > 1:
+                    # Independent combos (all seeds flattened) share device
+                    # batches through the BatchingBackend; results stay
+                    # bit-identical to sequential execution (per-request PRNG
+                    # keys).
+                    from concurrent.futures import ThreadPoolExecutor
 
-                def worker(run):
-                    with tracer.adopt(parent_path), batching.session():
+                    from consensus_tpu.backends.batching import BatchingBackend
+
+                    batching = BatchingBackend(
+                        self.backend,
+                        flush_ms=float(self.config.get("batch_flush_ms", 10.0)),
+                        expected_sessions=min(max_workers, len(pending)),
+                    )
+
+                    def worker(item):
+                        index, run = item
+                        with tracer.adopt(parent_path), batching.session():
+                            logger.info(
+                                "Running %s with %s", run["method"], run["config"]
+                            )
+                            row = self._run_one(
+                                run["method"], run["config"], run["seed"],
+                                backend=batching,
+                            )
+                        return index, finish(index, run, row)
+
+                    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+                        for index, row in pool.map(worker, pending):
+                            rows_by_index[index] = row
+                    self.last_batch_counts = dict(batching.batch_counts)
+                    logger.info(
+                        "Device batches issued: %s (%d runs, %d workers)",
+                        batching.batch_counts, len(pending), max_workers,
+                    )
+                else:
+                    for index, run in pending:
                         logger.info(
                             "Running %s with %s", run["method"], run["config"]
                         )
-                        return self._run_one(
-                            run["method"], run["config"], run["seed"],
-                            backend=batching,
+                        row = self._run_one(
+                            run["method"], run["config"], run["seed"]
                         )
+                        rows_by_index[index] = finish(index, run, row)
+        finally:
+            journal.close()
 
-                with ThreadPoolExecutor(max_workers=max_workers) as pool:
-                    rows = list(pool.map(worker, runs))
-                self.last_batch_counts = dict(batching.batch_counts)
-                logger.info(
-                    "Device batches issued: %s (%d runs, %d workers)",
-                    batching.batch_counts, len(runs), max_workers,
-                )
-            else:
-                rows = []
-                for run in runs:
-                    logger.info("Running %s with %s", run["method"], run["config"])
-                    rows.append(
-                        self._run_one(run["method"], run["config"], run["seed"])
-                    )
-
+        rows = [rows_by_index[index] for index in range(len(runs))]
         frame = pd.DataFrame(rows)
         lead = [c for c in _LEAD_COLUMNS if c in frame.columns]
         rest = sorted(c for c in frame.columns if c not in lead)
         frame = frame[lead + rest]
-        frame.to_csv(self.run_dir / "results.csv", index=False)
+        atomic_write_text(
+            self.run_dir / "results.csv", frame.to_csv(index=False)
+        )
         get_tracer().write(self.run_dir / "timing.json")
         self._write_metrics(metrics_before, spans_before)
         self._write_token_counts(tokens_before, wall_start, len(frame))
@@ -266,8 +420,6 @@ class Experiment:
         derived headline numbers; ``metrics.prom`` is the cumulative
         process registry in Prometheus text exposition (what a scrape
         endpoint would serve)."""
-        import json
-
         registry = get_registry()
         delta = diff_snapshots(metrics_before, registry.snapshot())
         span_delta = diff_span_paths(
@@ -282,9 +434,10 @@ class Experiment:
                 "bucket_recompiles": bucket_recompiles(delta),
             },
         }
-        with open(self.run_dir / "metrics.json", "w") as fh:
-            json.dump(payload, fh, indent=2)
-        (self.run_dir / "metrics.prom").write_text(registry.to_prometheus())
+        atomic_write_json(self.run_dir / "metrics.json", payload)
+        atomic_write_text(
+            self.run_dir / "metrics.prom", registry.to_prometheus()
+        )
 
     def _write_token_counts(
         self, before: Dict[str, int], wall_start: float, statements: int
@@ -296,8 +449,6 @@ class Experiment:
         after = getattr(self.backend, "token_counts", None)
         if not after:
             return
-        import json
-
         wall = time.perf_counter() - wall_start
         generated = int(after.get("generated", 0) - (before.get("generated") or 0))
         scored = int(after.get("scored", 0) - (before.get("scored") or 0))
@@ -313,5 +464,4 @@ class Experiment:
             else None,
             "pinned_budget": bool(self.config.get("timing_pin_budget", False)),
         }
-        with open(self.run_dir / "token_counts.json", "w") as fh:
-            json.dump(payload, fh, indent=2)
+        atomic_write_json(self.run_dir / "token_counts.json", payload)

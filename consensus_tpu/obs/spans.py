@@ -118,7 +118,9 @@ class SpanTracer:
         return roots
 
     def write(self, path) -> None:
-        pathlib.Path(path).write_text(json.dumps(self.summary(), indent=2))
+        from consensus_tpu.utils.io_atomic import atomic_write_json
+
+        atomic_write_json(path, self.summary())
 
     def reset(self) -> None:
         with self._lock:

@@ -41,11 +41,24 @@ def create_server(
     flush_ms: float = 10.0,
     generation_model: str = "",
     registry=None,
+    fault_plan=None,
+    supervise=None,
 ) -> ConsensusServer:
-    """Wire backend → service → scheduler → HTTP server (not yet started)."""
-    from consensus_tpu.backends import get_backend
+    """Wire backend → service → scheduler → HTTP server (not yet started).
+
+    ``fault_plan`` (chaos testing) and ``supervise`` layer the
+    fault-tolerance stack over the engine via
+    :func:`consensus_tpu.backends.wrap_backend`; a supervised engine's
+    circuit breaker is picked up by the scheduler's admission control and
+    surfaced in ``/healthz``."""
+    from consensus_tpu.backends import get_backend, wrap_backend
 
     engine = get_backend(backend, **(backend_options or {}))
+    if fault_plan is not None or supervise:
+        engine = wrap_backend(
+            engine, fault_plan=fault_plan, supervise=supervise,
+            registry=registry,
+        )
     service = ConsensusService(engine, generation_model=generation_model)
     scheduler = RequestScheduler(
         handler=service.run,

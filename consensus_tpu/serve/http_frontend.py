@@ -6,7 +6,8 @@ dependencies) in front of :class:`RequestScheduler`:
 * ``POST /v1/consensus`` — validate → admit → wait → respond.  Errors are
   structured JSON (``{"error": {"type", "message", ...}}``) with the HTTP
   status carrying the overload semantics: 400 validation, 429 admission
-  rejection (with ``Retry-After``), 504 deadline expiry, 500 terminal
+  rejection (with ``Retry-After``), 503 circuit-breaker open
+  (``Retry-After`` = breaker cooldown), 504 deadline expiry, 500 terminal
   backend failure.
 * ``GET /healthz`` — queue depth, in-flight count, drain state, backend
   liveness, device-batch accounting (the coalescing proof surface).
@@ -99,11 +100,7 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         try:
             ticket = scheduler.submit(request)
         except SchedulerRejected as exc:
-            self._send_json(429, {"error": {
-                "type": "rejected",
-                "reason": exc.reason,
-                "message": str(exc),
-            }}, headers={"Retry-After": "1"})
+            self._send_rejection(exc)
             return
         remaining = ticket.remaining()
         wait_s = (
@@ -124,10 +121,7 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
             self._send_error_json(504, "timeout", str(exc))
             return
         except SchedulerRejected as exc:
-            self._send_json(429, {"error": {
-                "type": "rejected", "reason": exc.reason,
-                "message": str(exc),
-            }}, headers={"Retry-After": "1"})
+            self._send_rejection(exc)
             return
         except Exception as exc:
             self._send_json(500, {"error": {
@@ -140,6 +134,18 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         self._send_json(200, result)
 
     # -- helpers -----------------------------------------------------------
+
+    def _send_rejection(self, exc: SchedulerRejected) -> None:
+        """Admission rejections: 503 for an open circuit breaker (the
+        backend is down — clients should back off for its cooldown), 429
+        for overload (queue_full/draining — retry soon elsewhere)."""
+        status = 503 if exc.reason == "breaker_open" else 429
+        retry_after = exc.retry_after_s if exc.retry_after_s is not None else 1
+        self._send_json(status, {"error": {
+            "type": "rejected",
+            "reason": exc.reason,
+            "message": str(exc),
+        }}, headers={"Retry-After": str(int(max(1, retry_after)))})
 
     def _health_payload(self) -> Dict[str, Any]:
         scheduler = self.server.scheduler

@@ -145,7 +145,7 @@ def run_loadgen(
     def classify(outcome: RequestOutcome) -> str:
         if outcome.status == 200:
             return "ok"
-        if outcome.status == 429:
+        if outcome.status in (429, 503):  # overload / breaker open
             return "rejected"
         if outcome.status == 504 or outcome.error_type == "timeout":
             return "timeout"
@@ -170,6 +170,9 @@ def run_loadgen(
         "throughput_rps": round(len(ok) / wall_s, 3) if wall_s > 0 else 0.0,
         "rejection_rate": round(len(rejected) / len(payloads), 4)
         if payloads else 0.0,
+        # Availability under faults: fraction of offered requests that got
+        # a 200 — the headline chaos/SLO number.
+        "availability": round(len(ok) / len(payloads), 4) if payloads else 0.0,
         "latency_ms": {
             "p50": round(_percentile(latencies, 0.50) * 1e3, 2),
             "p95": round(_percentile(latencies, 0.95) * 1e3, 2),

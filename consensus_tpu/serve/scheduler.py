@@ -43,7 +43,7 @@ import threading
 import time
 from typing import Any, Callable, Deque, Dict, List, Optional
 
-from consensus_tpu.backends.base import Backend
+from consensus_tpu.backends.base import Backend, TransientBackendError
 from consensus_tpu.backends.batching import BatchingBackend
 from consensus_tpu.obs.metrics import Registry, get_registry
 
@@ -51,16 +51,26 @@ logger = logging.getLogger(__name__)
 
 #: Exception types considered transient (retryable).  Validation/config
 #: errors (ValueError/KeyError/TypeError) are not in this set on purpose:
-#: resubmitting a bad request can never succeed.
-TRANSIENT_EXCEPTIONS = (RuntimeError, ConnectionError, TimeoutError, OSError)
+#: resubmitting a bad request can never succeed.  Of the backend error
+#: taxonomy only :class:`TransientBackendError` is here — integrity and
+#: device-lost errors are deterministic, so resubmitting cannot help.
+TRANSIENT_EXCEPTIONS = (
+    TransientBackendError, RuntimeError, ConnectionError, TimeoutError,
+    OSError,
+)
 
 
 class SchedulerRejected(Exception):
-    """Admission control refused the request (explicit overload signal)."""
+    """Admission control refused the request (explicit overload signal).
 
-    def __init__(self, reason: str, message: str):
+    ``retry_after_s`` is set for breaker-open rejections: the cooldown
+    remaining, surfaced as an HTTP ``Retry-After`` header."""
+
+    def __init__(self, reason: str, message: str,
+                 retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class RequestTimeout(Exception):
@@ -145,6 +155,10 @@ class RequestScheduler:
             raise ValueError("max_queue_depth and max_inflight must be >= 1")
         self.handler = handler
         self.inner_backend = backend
+        #: Supervised backends expose their breaker; admission consults it
+        #: so an open breaker sheds load BEFORE requests queue up behind a
+        #: failing device (and the half-open probe admits exactly one).
+        self.circuit_breaker = getattr(backend, "circuit_breaker", None)
         self.max_queue_depth = int(max_queue_depth)
         self.max_inflight = int(max_inflight)
         self.default_timeout_s = default_timeout_s
@@ -175,7 +189,7 @@ class RequestScheduler:
         self._m_rejected = reg.counter(
             "serve_rejected_total",
             "Requests refused at admission, by reason "
-            "(queue_full|draining|stopped).",
+            "(queue_full|draining|stopped|breaker_open).",
             labels=("reason",),
         )
         self._m_timeout = reg.counter(
@@ -266,6 +280,13 @@ class RequestScheduler:
                 self._m_rejected.labels("draining").inc()
                 raise SchedulerRejected(
                     "draining", "server is draining; not accepting requests")
+            breaker = self.circuit_breaker
+            if breaker is not None and not breaker.admission_allowed():
+                self._m_rejected.labels("breaker_open").inc()
+                raise SchedulerRejected(
+                    "breaker_open",
+                    "backend circuit breaker is open; retry after cooldown",
+                    retry_after_s=breaker.retry_after_s())
             if len(self._queue) >= self.max_queue_depth:
                 self._m_rejected.labels("queue_full").inc()
                 raise SchedulerRejected(
@@ -281,7 +302,7 @@ class RequestScheduler:
     def stats(self) -> Dict[str, Any]:
         """Live occupancy for /healthz."""
         with self._lock:
-            return {
+            stats = {
                 "queue_depth": len(self._queue),
                 "inflight": self._inflight_count,
                 "max_queue_depth": self.max_queue_depth,
@@ -290,6 +311,9 @@ class RequestScheduler:
                 "workers_alive": sum(t.is_alive() for t in self._workers),
                 "device_batches": dict(self.batching.batch_counts),
             }
+        if self.circuit_breaker is not None:
+            stats["circuit_breaker"] = self.circuit_breaker.snapshot()
+        return stats
 
     # -- workers -----------------------------------------------------------
 

@@ -54,6 +54,13 @@ def main(argv=None) -> int:
     parser.add_argument("--metrics-out", default=None,
                         help="write the serve-side registry snapshot delta "
                              "(metrics.json schema) here (self-contained)")
+    parser.add_argument("--fault-plan", default=None,
+                        help="(self-contained) JSON fault plan injected "
+                             "below a supervised backend, e.g. "
+                             '\'{"seed": 7, "faults": [{"kind": '
+                             '"transient_error", "rate": 0.05}]}\'; the '
+                             "report gains availability and retried "
+                             "fraction")
     args = parser.parse_args(argv)
     if bool(args.url) == bool(args.self_contained):
         parser.error("exactly one of --url / --self-contained is required")
@@ -76,12 +83,14 @@ def main(argv=None) -> int:
     if args.self_contained:
         from consensus_tpu.obs import diff_snapshots, get_registry
         from consensus_tpu.serve import create_server
+        from consensus_tpu.utils.io_atomic import atomic_write_json
 
         server = create_server(
             backend="fake",
             port=0,  # ephemeral
             max_inflight=args.max_inflight,
             max_queue_depth=args.max_queue_depth,
+            fault_plan=args.fault_plan,
         ).start()
         before = get_registry().snapshot()
         try:
@@ -93,12 +102,22 @@ def main(argv=None) -> int:
                 "device_batches"]
         finally:
             server.stop()
+        delta = diff_snapshots(before, get_registry().snapshot())
+
+        def family_total(name):
+            family = (delta.get("families") or {}).get(name) or {}
+            return sum(s.get("value", 0) for s in family.get("series", []))
+
+        # Retries absorbed below the HTTP surface: supervisor-level call
+        # retries plus scheduler-level ticket retries, per offered request.
+        retries = family_total("supervisor_retries_total") + family_total(
+            "serve_retried_total")
+        report["retried_fraction"] = (
+            round(retries / args.requests, 4) if args.requests else 0.0)
         if args.metrics_out:
-            delta = diff_snapshots(before, get_registry().snapshot())
             payload = {"schema": "consensus_tpu.metrics.v1",
                        "metrics": delta}
-            pathlib.Path(args.metrics_out).write_text(
-                json.dumps(payload, indent=2))
+            atomic_write_json(pathlib.Path(args.metrics_out), payload)
     else:
         report = run_loadgen(
             args.url, payloads, args.rate,

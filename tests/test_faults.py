@@ -1,0 +1,166 @@
+"""Deterministic fault injection (backends/faults.py)."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends import FakeBackend, GenerationRequest, ScoreRequest
+from consensus_tpu.backends.base import BackendLostError, NextTokenRequest
+from consensus_tpu.backends.faults import (
+    FaultInjectingBackend,
+    FaultPlan,
+    FaultSpec,
+)
+from consensus_tpu.obs.metrics import Registry
+
+
+def make(plan, **kwargs):
+    return FaultInjectingBackend(
+        FakeBackend(), plan, registry=Registry(), **kwargs
+    )
+
+
+class TestFaultSpec:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec(kind="nope")
+
+    def test_unknown_op_rejected(self):
+        with pytest.raises(ValueError, match="unknown op"):
+            FaultSpec(kind="latency", op="frobnicate")
+
+    def test_rate_bounds(self):
+        with pytest.raises(ValueError, match="rate"):
+            FaultSpec(kind="transient_error", rate=1.5)
+
+    def test_rate_firing_is_deterministic(self):
+        spec = FaultSpec(kind="transient_error", rate=0.3)
+        fired = [spec.fires(7, 0, "generate", i) for i in range(64)]
+        assert fired == [spec.fires(7, 0, "generate", i) for i in range(64)]
+        assert any(fired) and not all(fired)
+        # Different seed -> different firing pattern.
+        assert fired != [spec.fires(8, 0, "generate", i) for i in range(64)]
+
+
+class TestFaultPlan:
+    def test_from_spec_accepts_dict_json_and_none(self):
+        plan = FaultPlan.from_spec(
+            {"seed": 3, "faults": [{"kind": "latency", "latency_s": 0.1}]}
+        )
+        assert plan.seed == 3 and plan.faults[0].kind == "latency"
+        as_json = FaultPlan.from_spec(json.dumps(
+            {"faults": [{"kind": "truncate", "op": "generate"}]}))
+        assert as_json.faults[0].op == "generate"
+        assert FaultPlan.from_spec(None) is None
+        assert FaultPlan.from_spec(plan) is plan
+
+    def test_from_spec_rejects_non_dict(self):
+        with pytest.raises(ValueError, match="fault plan"):
+            FaultPlan.from_spec("[1, 2]")
+
+
+class TestInjection:
+    def test_transient_error_at_pinned_call_index(self):
+        backend = make({"faults": [
+            {"kind": "transient_error", "op": "generate", "call_index": 1}]})
+        req = [GenerationRequest(user_prompt="p", seed=0, max_tokens=8)]
+        backend.generate(req)  # call 0: clean
+        with pytest.raises(RuntimeError, match="injected transient"):
+            backend.generate(req)  # call 1: faulted
+        backend.generate(req)  # call 2: clean again
+
+    def test_timeout_error_kind(self):
+        backend = make({"faults": [
+            {"kind": "timeout_error", "op": "score", "call_index": 0}]})
+        with pytest.raises(TimeoutError):
+            backend.score([ScoreRequest(context="c", continuation="x")])
+
+    def test_truncate_halves_text_and_sets_finish_reason(self):
+        clean = FakeBackend()
+        backend = make({"faults": [
+            {"kind": "truncate", "op": "generate", "call_index": 0}]})
+        req = [GenerationRequest(user_prompt="p", seed=0, max_tokens=32)]
+        ref = clean.generate(req)[0]
+        res = backend.generate(req)[0]
+        assert res.finish_reason == "length"
+        assert res.text == ref.text[: max(1, len(ref.text) // 2)]
+
+    def test_nan_poison_targets_one_score_row(self):
+        backend = make({"faults": [
+            {"kind": "nan_logprobs", "op": "score", "call_index": 0,
+             "row_index": 1}]})
+        reqs = [ScoreRequest(context="c", continuation=f"row {i}")
+                for i in range(3)]
+        results = backend.score(reqs)
+        clean = FakeBackend().score(reqs)
+        assert math.isnan(results[1].logprobs[0])
+        assert results[0].logprobs == clean[0].logprobs
+        assert results[2].logprobs == clean[2].logprobs
+
+    def test_inf_poison_next_token(self):
+        backend = make({"faults": [
+            {"kind": "inf_logprobs", "op": "next_token", "call_index": 0}]})
+        cands = backend.next_token_logprobs(
+            [NextTokenRequest(user_prompt="p", k=3)])[0]
+        assert math.isinf(cands[0].logprob)
+
+    def test_embed_poison(self):
+        backend = make({"faults": [
+            {"kind": "nan_logprobs", "op": "embed", "call_index": 0,
+             "row_index": 0}]})
+        vectors = backend.embed(["a", "b"])
+        assert np.isnan(vectors[0, 0]) and np.isfinite(vectors[1]).all()
+
+    def test_device_lost_is_sticky(self):
+        backend = make({"faults": [
+            {"kind": "device_lost", "op": "generate", "call_index": 1}]})
+        req = [GenerationRequest(user_prompt="p", seed=0, max_tokens=8)]
+        backend.generate(req)
+        with pytest.raises(BackendLostError):
+            backend.generate(req)
+        # Every subsequent call on every op fails: the device is gone.
+        with pytest.raises(BackendLostError):
+            backend.score([ScoreRequest(context="c", continuation="x")])
+        with pytest.raises(BackendLostError):
+            backend.embed(["a"])
+
+    def test_latency_uses_injected_sleep(self):
+        slept = []
+        backend = FaultInjectingBackend(
+            FakeBackend(),
+            {"faults": [{"kind": "latency", "op": "generate",
+                         "call_index": 0, "latency_s": 1.5}]},
+            registry=Registry(),
+            sleep=slept.append,
+        )
+        backend.generate([GenerationRequest(user_prompt="p", max_tokens=4)])
+        assert slept == [1.5]
+
+    def test_injection_counter(self):
+        registry = Registry()
+        backend = FaultInjectingBackend(
+            FakeBackend(),
+            {"faults": [{"kind": "transient_error", "op": "generate",
+                         "call_index": 0}]},
+            registry=registry,
+        )
+        with pytest.raises(RuntimeError):
+            backend.generate([GenerationRequest(user_prompt="p")])
+        prom = registry.to_prometheus()
+        assert 'faults_injected_total{kind="transient_error",op="generate"} 1'\
+            in prom
+
+    def test_no_fused_session_escape_hatch(self):
+        # Fused sessions would bypass the injection seam; the wrapper must
+        # not advertise the capability.
+        backend = make({"faults": []})
+        assert not hasattr(backend, "open_fused_token_search")
+
+    def test_clean_plan_is_bit_transparent(self):
+        backend = make({"faults": []})
+        reqs = [GenerationRequest(user_prompt="p", seed=s, max_tokens=16)
+                for s in range(3)]
+        assert [r.text for r in backend.generate(reqs)] == [
+            r.text for r in FakeBackend().generate(reqs)]

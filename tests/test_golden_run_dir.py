@@ -26,6 +26,7 @@ def test_golden_tree_is_complete():
     for expected in [
         "config.yaml",
         "results.csv",
+        "journal.jsonl",
         "timing.json",
         "token_counts.json",
         "metrics.json",

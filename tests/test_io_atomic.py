@@ -1,0 +1,96 @@
+"""Atomic-write and journal primitives (utils/io_atomic.py)."""
+
+import json
+import os
+
+from consensus_tpu.utils.io_atomic import (
+    JOURNAL_SCHEMA,
+    JournalWriter,
+    atomic_write_bytes,
+    atomic_write_json,
+    atomic_write_text,
+    read_journal,
+)
+
+
+class TestAtomicWrite:
+    def test_write_and_overwrite(self, tmp_path):
+        target = tmp_path / "out.txt"
+        atomic_write_text(target, "first")
+        assert target.read_text() == "first"
+        atomic_write_text(target, "second")
+        assert target.read_text() == "second"
+
+    def test_no_tmp_files_left_behind(self, tmp_path):
+        target = tmp_path / "out.bin"
+        atomic_write_bytes(target, b"payload")
+        assert [p.name for p in tmp_path.iterdir()] == ["out.bin"]
+
+    def test_creates_parent_dirs(self, tmp_path):
+        target = tmp_path / "a" / "b" / "out.json"
+        atomic_write_json(target, {"k": 1})
+        assert json.loads(target.read_text()) == {"k": 1}
+
+    def test_failure_leaves_destination_untouched(self, tmp_path):
+        target = tmp_path / "out.json"
+        atomic_write_text(target, "intact")
+        try:
+            atomic_write_json(target, {"bad": object()})
+        except TypeError:
+            pass
+        else:  # pragma: no cover - defensive
+            raise AssertionError("expected unserializable payload to raise")
+        assert target.read_text() == "intact"
+        assert [p.name for p in tmp_path.iterdir()] == ["out.json"]
+
+
+class TestJournal:
+    def test_append_and_read_roundtrip(self, tmp_path):
+        path = tmp_path / "journal.jsonl"
+        with JournalWriter(path) as journal:
+            journal.append({"key": {"seed": 1}, "row": {"x": 1}})
+            journal.append({"key": {"seed": 2}, "row": {"x": 2}})
+        records = read_journal(path)
+        assert [r["key"]["seed"] for r in records] == [1, 2]
+        assert all(r["schema"] == JOURNAL_SCHEMA for r in records)
+
+    def test_missing_file_reads_empty(self, tmp_path):
+        assert read_journal(tmp_path / "nope.jsonl") == []
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        path = tmp_path / "journal.jsonl"
+        with JournalWriter(path) as journal:
+            journal.append({"row": {"x": 1}})
+        # Simulate a crash mid-append: a partial, unterminated JSON line.
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"schema": "' + JOURNAL_SCHEMA + '", "row": {"x')
+        records = read_journal(path)
+        assert len(records) == 1
+        assert records[0]["row"] == {"x": 1}
+
+    def test_wrong_schema_lines_are_skipped(self, tmp_path):
+        path = tmp_path / "journal.jsonl"
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({"schema": "other.v9", "row": {}}) + "\n")
+            fh.write(json.dumps({"schema": JOURNAL_SCHEMA, "row": {"ok": 1}})
+                     + "\n")
+        records = read_journal(path)
+        assert len(records) == 1 and records[0]["row"] == {"ok": 1}
+
+    def test_append_after_reopen_extends(self, tmp_path):
+        path = tmp_path / "journal.jsonl"
+        with JournalWriter(path) as journal:
+            journal.append({"row": {"i": 0}})
+        with JournalWriter(path) as journal:
+            journal.append({"row": {"i": 1}})
+        assert [r["row"]["i"] for r in read_journal(path)] == [0, 1]
+
+    def test_fsync_visible_on_disk_immediately(self, tmp_path):
+        path = tmp_path / "journal.jsonl"
+        journal = JournalWriter(path)
+        journal.append({"row": {"i": 0}})
+        # Another reader (a resume in a new process) sees the record even
+        # though the writer is still open.
+        assert len(read_journal(path)) == 1
+        assert os.path.getsize(path) > 0
+        journal.close()

@@ -1,0 +1,262 @@
+"""Backend supervision: retry, integrity guards, bisection, breaker."""
+
+import math
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends import FakeBackend, GenerationRequest, ScoreRequest
+from consensus_tpu.backends.base import (
+    BackendIntegrityError,
+    BackendLostError,
+    PartialBatchError,
+    TransientBackendError,
+)
+from consensus_tpu.backends.faults import FaultInjectingBackend
+from consensus_tpu.backends.supervisor import CircuitBreaker, SupervisedBackend
+from consensus_tpu.obs.metrics import Registry
+
+
+def supervised(plan=None, **kwargs):
+    registry = Registry()
+    inner = FakeBackend()
+    if plan is not None:
+        inner = FaultInjectingBackend(inner, plan, registry=registry)
+    kwargs.setdefault("sleep", lambda _s: None)
+    return SupervisedBackend(inner, registry=registry, **kwargs), registry
+
+
+class TestRetry:
+    def test_transient_fault_retried_bit_identical(self):
+        backend, registry = supervised(plan={"faults": [
+            {"kind": "transient_error", "op": "generate", "call_index": 0}]})
+        reqs = [GenerationRequest(user_prompt="p", seed=s, max_tokens=16)
+                for s in range(2)]
+        out = backend.generate(reqs)
+        ref = FakeBackend().generate(reqs)
+        assert [r.text for r in out] == [r.text for r in ref]
+        assert 'supervisor_retries_total{op="generate"} 1' in \
+            registry.to_prometheus()
+
+    def test_retry_budget_exhaustion_raises_typed_error(self):
+        backend, _ = supervised(
+            plan={"faults": [
+                {"kind": "transient_error", "op": "generate", "rate": 1.0}]},
+            max_retries=2,
+        )
+        with pytest.raises(TransientBackendError, match="3 attempt"):
+            backend.generate([GenerationRequest(user_prompt="p")])
+
+    def test_backoff_is_exponential(self):
+        delays = []
+        registry = Registry()
+        inner = FaultInjectingBackend(
+            FakeBackend(),
+            {"faults": [{"kind": "transient_error", "op": "generate",
+                         "rate": 1.0}]},
+            registry=registry,
+        )
+        backend = SupervisedBackend(
+            inner, max_retries=3, backoff_s=0.01, registry=registry,
+            sleep=delays.append,
+        )
+        with pytest.raises(TransientBackendError):
+            backend.generate([GenerationRequest(user_prompt="p")])
+        assert delays == [0.01, 0.02, 0.04]
+
+    def test_empty_request_list_passthrough(self):
+        backend, _ = supervised()
+        assert backend.generate([]) == []
+
+
+class TestIntegrityGuards:
+    def test_all_rows_poisoned_raises_integrity(self):
+        backend, _ = supervised(plan={"faults": [
+            {"kind": "nan_logprobs", "op": "score", "call_index": 0}]})
+        with pytest.raises(BackendIntegrityError, match="every row"):
+            backend.score([ScoreRequest(context="c", continuation="x")])
+
+    def test_one_poisoned_row_raises_partial_with_siblings(self):
+        backend, _ = supervised(plan={"faults": [
+            {"kind": "nan_logprobs", "op": "score", "call_index": 0,
+             "row_index": 1}]})
+        reqs = [ScoreRequest(context="c", continuation=f"row {i}")
+                for i in range(3)]
+        with pytest.raises(PartialBatchError) as excinfo:
+            backend.score(reqs)
+        err = excinfo.value
+        assert set(err.row_errors) == {1}
+        assert isinstance(err.row_errors[1], BackendIntegrityError)
+        clean = FakeBackend().score(reqs)
+        assert err.results[0].logprobs == clean[0].logprobs
+        assert err.results[2].logprobs == clean[2].logprobs
+
+    def test_poison_never_retried(self):
+        backend, registry = supervised(plan={"faults": [
+            {"kind": "inf_logprobs", "op": "score", "call_index": 0}]})
+        with pytest.raises(BackendIntegrityError):
+            backend.score([ScoreRequest(context="c", continuation="x")])
+        # Family is registered but no retry series was ever incremented.
+        assert "supervisor_retries_total{" not in registry.to_prometheus()
+
+    def test_embed_guard(self):
+        backend, _ = supervised(plan={"faults": [
+            {"kind": "nan_logprobs", "op": "embed", "call_index": 0,
+             "row_index": 0}]})
+        with pytest.raises(PartialBatchError) as excinfo:
+            backend.embed(["a", "b"])
+        assert set(excinfo.value.row_errors) == {0}
+
+    def test_guard_can_be_disabled(self):
+        backend, _ = supervised(
+            plan={"faults": [
+                {"kind": "nan_logprobs", "op": "score", "call_index": 0}]},
+            guard_nonfinite=False,
+        )
+        result = backend.score(
+            [ScoreRequest(context="c", continuation="x")])[0]
+        assert math.isnan(result.logprobs[0])  # caller opted out
+
+
+class _RowPoisonBackend:
+    """Raises deterministically (non-transient) for one specific request."""
+
+    name = "row-poison"
+
+    def __init__(self, bad_continuation):
+        self.inner = FakeBackend()
+        self.bad = bad_continuation
+
+    def score(self, requests):
+        if any(r.continuation == self.bad for r in requests):
+            raise ValueError(f"poison row {self.bad!r}")
+        return self.inner.score(requests)
+
+
+class TestBisection:
+    def test_bisection_isolates_poison_row(self):
+        registry = Registry()
+        backend = SupervisedBackend(
+            _RowPoisonBackend("row 2"), registry=registry,
+            sleep=lambda _s: None,
+        )
+        reqs = [ScoreRequest(context="c", continuation=f"row {i}")
+                for i in range(4)]
+        with pytest.raises(PartialBatchError) as excinfo:
+            backend.score(reqs)
+        err = excinfo.value
+        assert set(err.row_errors) == {2}
+        assert isinstance(err.row_errors[2], BackendIntegrityError)
+        clean = FakeBackend().score(reqs)
+        for i in (0, 1, 3):
+            assert err.results[i].logprobs == clean[i].logprobs
+        assert 'supervisor_bisections_total{op="score"} 1' in \
+            registry.to_prometheus()
+
+    def test_single_row_deterministic_failure_is_integrity_error(self):
+        backend = SupervisedBackend(
+            _RowPoisonBackend("only"), registry=Registry(),
+            sleep=lambda _s: None,
+        )
+        with pytest.raises(BackendIntegrityError, match="deterministically"):
+            backend.score([ScoreRequest(context="c", continuation="only")])
+
+
+class TestCircuitBreaker:
+    def make(self, **kwargs):
+        self.now = 0.0
+        kwargs.setdefault("failure_threshold", 2)
+        kwargs.setdefault("cooldown_s", 10.0)
+        return CircuitBreaker(
+            clock=lambda: self.now, registry=Registry(), **kwargs
+        )
+
+    def test_opens_after_threshold_and_decays_to_half_open(self):
+        breaker = self.make()
+        breaker.record_failure()
+        assert breaker.state == "closed"
+        breaker.record_failure()
+        assert breaker.state == "open"
+        assert not breaker.allow_call()
+        self.now += 10.0
+        assert breaker.state == "half_open"
+        assert breaker.allow_call()
+
+    def test_half_open_admits_exactly_one_probe(self):
+        breaker = self.make()
+        breaker.record_failure(); breaker.record_failure()
+        self.now += 10.0
+        assert breaker.admission_allowed()
+        assert not breaker.admission_allowed()
+        assert not breaker.admission_allowed()
+
+    def test_stale_probe_slot_recovers(self):
+        breaker = self.make()
+        breaker.record_failure(); breaker.record_failure()
+        self.now += 10.0
+        assert breaker.admission_allowed()
+        # The probe request died silently; after another cooldown a new
+        # probe is admitted rather than wedging the breaker forever.
+        self.now += 10.0
+        assert breaker.admission_allowed()
+
+    def test_probe_success_closes(self):
+        breaker = self.make()
+        breaker.record_failure(); breaker.record_failure()
+        self.now += 10.0
+        assert breaker.admission_allowed()
+        breaker.record_success()
+        assert breaker.state == "closed"
+        assert breaker.admission_allowed()
+
+    def test_probe_failure_reopens_with_fresh_cooldown(self):
+        breaker = self.make()
+        breaker.record_failure(); breaker.record_failure()
+        self.now += 10.0
+        assert breaker.admission_allowed()
+        breaker.record_failure()
+        assert breaker.state == "open"
+        self.now += 5.0
+        assert breaker.state == "open"  # fresh cooldown, not the old one
+        assert breaker.retry_after_s() >= 1.0
+
+    def test_supervisor_fails_fast_when_open(self):
+        registry = Registry()
+        breaker = CircuitBreaker(
+            failure_threshold=1, cooldown_s=100.0, registry=registry,
+        )
+        backend = SupervisedBackend(
+            FakeBackend(), breaker=breaker, registry=registry,
+            sleep=lambda _s: None,
+        )
+        breaker.record_failure()
+        with pytest.raises(BackendLostError, match="circuit breaker open"):
+            backend.generate([GenerationRequest(user_prompt="p")])
+
+    def test_device_lost_counts_toward_breaker(self):
+        backend, _ = supervised(
+            plan={"faults": [
+                {"kind": "device_lost", "op": "generate", "call_index": 0}]},
+            failure_threshold=1, cooldown_s=100.0,
+        )
+        with pytest.raises(BackendLostError):
+            backend.generate([GenerationRequest(user_prompt="p")])
+        assert backend.circuit_breaker.state == "open"
+
+
+class TestPassthrough:
+    def test_properties_delegate(self):
+        backend, _ = supervised()
+        inner = FakeBackend()
+        assert backend.token_counts.keys() == inner.token_counts.keys()
+        assert backend.deterministic_greedy == bool(
+            getattr(inner, "deterministic_greedy", False))
+
+    def test_embed_returns_ndarray(self):
+        backend, _ = supervised()
+        vectors = backend.embed(["a", "b"])
+        assert isinstance(vectors, np.ndarray) and vectors.shape[0] == 2
+
+    def test_no_fused_session_escape_hatch(self):
+        backend, _ = supervised()
+        assert not hasattr(backend, "open_fused_token_search")
