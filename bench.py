@@ -298,6 +298,47 @@ def main() -> None:
             "chaos_requests": chaos_requests,
         }
 
+    # ---- brownout cell: the serve stack under deliberate overload ----
+    # Open-loop load at roughly 2x the worker pool's drain rate with the
+    # brownout controller ON and tight per-request deadlines: the graceful-
+    # degradation claim measured — availability should hold near 1.0 while
+    # degraded_fraction reports how many answers paid for it with a
+    # shrunken search budget.  BENCH_BROWNOUT=0 skips.
+    brownout_extra = {}
+    if os.environ.get("BENCH_BROWNOUT", "1") != "0":
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+
+        brownout_requests = int(os.environ.get("BENCH_BROWNOUT_REQUESTS", "32"))
+        brownout_rate = float(os.environ.get("BENCH_BROWNOUT_RATE", "100"))
+        server = create_server(
+            backend="fake", port=0, max_inflight=2, max_queue_depth=64,
+            brownout=True, default_timeout_s=30.0,
+        ).start()
+        try:
+            brownout_report = run_loadgen(
+                server.base_url,
+                scenario_requests(
+                    brownout_requests,
+                    params={"n": 8, "max_tokens": NEW_TOKENS},
+                    timeout_s=10.0,
+                ),
+                rate_rps=brownout_rate,
+            )
+            brownout_tiers = server.scheduler.stats().get("brownout", {})
+        finally:
+            server.stop()
+        brownout_extra = {
+            "brownout_availability": brownout_report["availability"],
+            "brownout_degraded_fraction": brownout_report["degraded_fraction"],
+            "brownout_p99_ms": brownout_report["latency_ms"]["p99"],
+            "brownout_peak_tier": max(
+                (int(t) for t, c in brownout_tiers.get(
+                    "tier_request_counts", {}).items() if c), default=0),
+            "brownout_requests": brownout_requests,
+            "brownout_offered_rate_rps": brownout_rate,
+        }
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -404,6 +445,7 @@ def main() -> None:
                     **mcts_extra,
                     **serve_extra,
                     **chaos_extra,
+                    **brownout_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

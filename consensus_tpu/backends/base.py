@@ -61,6 +61,15 @@ class BackendLostError(BackendError):
     circuit breaker).  Not retryable within this process."""
 
 
+class RequestCancelled(BackendError):
+    """The caller abandoned this request before its batch dispatched
+    (serving ticket cancelled / deadline passed), so the batching layer
+    dropped it at the flush snapshot instead of spending device time on it.
+    Not a backend failure and never retryable: the work was withdrawn, not
+    lost.  Deliberately NOT in the scheduler's TRANSIENT_EXCEPTIONS — a
+    cancelled request must not be resurrected by the retry loop."""
+
+
 class PartialBatchError(BackendError):
     """Some rows of a batched call failed and the rest succeeded.
 

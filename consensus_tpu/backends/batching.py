@@ -35,6 +35,7 @@ from consensus_tpu.backends.base import (
     GenerationResult,
     NextTokenRequest,
     PartialBatchError,
+    RequestCancelled,
     ScoreRequest,
     ScoreResult,
     TokenCandidate,
@@ -47,9 +48,12 @@ from consensus_tpu.obs.metrics import (
 
 
 class _Pending:
-    __slots__ = ("requests", "result", "error", "done", "enqueued", "in_flight")
+    __slots__ = (
+        "requests", "result", "error", "done", "enqueued", "in_flight",
+        "cancelled",
+    )
 
-    def __init__(self, requests):
+    def __init__(self, requests, cancelled=None):
         self.requests = requests
         self.result = None
         self.error = None
@@ -59,6 +63,14 @@ class _Pending:
         #: waiter then parks on the kind's DISPATCH condition, which is only
         #: notified when the entry's own batch completes (or aborts).
         self.in_flight = False
+        #: Session-scoped cancellation probe (the serving ticket's
+        #: ``cancelled`` flag), or None.  Consulted ONCE, at the flush
+        #: snapshot: a cancelled entry is dropped from the merged batch and
+        #: failed with :class:`RequestCancelled` before any device time is
+        #: spent on it.  Once an entry is in flight it always completes —
+        #: device programs are not preemptible, and co-batched siblings'
+        #: slices must stay bit-identical.
+        self.cancelled = cancelled
 
 
 class BatchingBackend:
@@ -109,6 +121,13 @@ class BatchingBackend:
             "unpacking; poison-row isolation).",
             labels=("kind",),
         )
+        self._cancelled_requests = reg.counter(
+            "batching_cancelled_requests_total",
+            "Queued calls dropped at the flush snapshot because their "
+            "session's cancellation probe fired before dispatch (failed "
+            "with RequestCancelled; no device time spent).",
+            labels=("kind",),
+        )
         self._spurious_wakeups = reg.counter(
             "batching_spurious_wakeups_total",
             "Mid-flush waiters woken while their own request was still "
@@ -148,6 +167,8 @@ class BatchingBackend:
         #: Device batches actually issued per kind — the measurable win:
         #: N concurrent runs << N× the solo batch count.
         self.batch_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+        #: Per-thread session cancellation probe (set by ``session()``).
+        self._tls = threading.local()
 
     @property
     def deterministic_greedy(self) -> bool:
@@ -181,14 +202,24 @@ class BatchingBackend:
             self._conds[kind].notify_all()
 
     @contextlib.contextmanager
-    def session(self):
-        """Register the calling thread as an active run for flush accounting."""
+    def session(self, cancelled: Optional[Callable[[], bool]] = None):
+        """Register the calling thread as an active run for flush accounting.
+
+        ``cancelled`` (optional) is a zero-arg probe — typically the serving
+        ticket's cancellation flag — attached to every call this thread
+        enqueues while the session is open.  Queued calls whose probe fires
+        are dropped at the next flush snapshot with
+        :class:`RequestCancelled` instead of riding the merged device batch;
+        calls already in flight complete normally (their co-batched
+        siblings' results must not change)."""
+        self._tls.cancelled = cancelled
         with self._lock:
             self._active += 1
             self._started += 1
         try:
             yield self
         finally:
+            self._tls.cancelled = None
             with self._lock:
                 self._active -= 1
                 # A departing session may complete the "all blocked"
@@ -246,7 +277,9 @@ class BatchingBackend:
     def _call(self, kind: str, requests: List[Any], fn: Callable) -> Any:
         if not requests:
             return fn(requests)
-        entry = _Pending(requests)
+        entry = _Pending(
+            requests, cancelled=getattr(self._tls, "cancelled", None)
+        )
         cond = self._conds[kind]
         with cond:
             self._queues[kind].append(entry)
@@ -309,18 +342,47 @@ class BatchingBackend:
         waiters)."""
         self._flushing = True
         snapshot: Dict[str, List[_Pending]] = {k: [] for k in self._queues}
+        dropped_kinds = set()
         released = False
         try:
             for k in kinds:
-                snapshot[k] = self._queues[k]
-                for entry in snapshot[k]:
-                    entry.in_flight = True
+                queue = self._queues[k]
                 self._queues[k] = []
+                live: List[_Pending] = []
+                for entry in queue:
+                    # Cancellation seam: consult the session's probe exactly
+                    # once, here, before the entry joins the merged batch.
+                    # Dropping pre-dispatch keeps sibling slices
+                    # bit-identical (per-request PRNG keys make results
+                    # independent of batch composition) and spends zero
+                    # device time on abandoned work.  A broken probe must
+                    # not abort the whole flush — treat it as not cancelled.
+                    probe = entry.cancelled
+                    try:
+                        is_cancelled = probe is not None and probe()
+                    except Exception:
+                        is_cancelled = False
+                    if is_cancelled:
+                        entry.error = RequestCancelled(
+                            f"session cancelled before its {k} call "
+                            "dispatched"
+                        )
+                        entry.done = True
+                        self._cancelled_requests.labels(k).inc()
+                        dropped_kinds.add(k)
+                    else:
+                        entry.in_flight = True
+                        live.append(entry)
+                snapshot[k] = live
             # Snapshotted kinds' waiters may be sitting in TIMED queue-cond
             # waits; wake them (still under the lock) so they re-park on the
             # dispatch condition — otherwise they'd miss their completion
             # wakeup and sleep out the rest of their quiescence window.
-            self._notify(k for k in kinds if snapshot[k])
+            # Kinds that only had entries DROPPED also wake: those waiters'
+            # entries are done (RequestCancelled) and must return now.
+            self._notify(
+                k for k in kinds if snapshot[k] or k in dropped_kinds
+            )
             self._lock.release()
             released = True
             self._run_batches(snapshot, reason)

@@ -229,6 +229,16 @@ class Experiment:
                         self.issue, self.agent_opinions
                     )
                 row["statement"] = statement
+                if generator.degraded:
+                    # Anytime early exit / scaled budget (budget_s or
+                    # budget_scale in the run config).  Keys appear ONLY on
+                    # degraded rows so full-budget sweeps keep their exact
+                    # historical CSV schema (tests/golden/).
+                    row["degraded"] = True
+                    row["degraded_reason"] = generator.degraded_reason
+                    row["budget_spent"] = json.dumps(
+                        generator.budget_spent, sort_keys=True
+                    )
                 if generator.pre_brushup_statement is not None and run_config.get(
                     "brushup", False
                 ):

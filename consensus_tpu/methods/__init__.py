@@ -10,6 +10,11 @@ from __future__ import annotations
 from typing import Any, Dict, Optional, Type
 
 from consensus_tpu.backends.base import Backend
+from consensus_tpu.methods.anytime import (
+    AnytimeResult,
+    BudgetClock,
+    BudgetExpired,
+)
 from consensus_tpu.methods.base import BaseGenerator
 from consensus_tpu.methods.beam_search import BeamSearchGenerator
 from consensus_tpu.methods.best_of_n import BestOfNGenerator
@@ -52,8 +57,11 @@ def get_method_generator(
 
 
 __all__ = [
+    "AnytimeResult",
     "BaseGenerator",
     "BeamSearchGenerator",
+    "BudgetClock",
+    "BudgetExpired",
     "BestOfNGenerator",
     "FiniteLookaheadGenerator",
     "GENERATOR_MAP",

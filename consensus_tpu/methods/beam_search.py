@@ -80,9 +80,15 @@ Beam = Tuple[str, List[float], int]
 
 
 class BeamSearchGenerator(BaseGenerator):
+    method_name = "beam_search"
+
     def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
         cfg = self.config
-        beam_width = int(cfg.get("beam_width", 3))
+        clock = self.budget_clock
+        beam_width_full = int(cfg.get("beam_width", 3))
+        # Brownout shrinks the beam; deadline expiry ends the token loop at
+        # the last completed step (every step leaves a rankable prefix).
+        beam_width = clock.scale_int(beam_width_full)
         max_tokens = int(cfg.get("max_tokens", 50))
         temperature = float(cfg.get("temperature", 1.0))
         use_biasing = bool(cfg.get("use_token_biasing", True))
@@ -97,6 +103,8 @@ class BeamSearchGenerator(BaseGenerator):
         agents = list(agent_opinions.items())
         if not agents:
             return ""
+        if clock.expired():
+            return self._degrade()
 
         system, user = reference_prompt(issue, agent_opinions, variant="beam_search")
         agent_prompts = tuple(
@@ -140,8 +148,23 @@ class BeamSearchGenerator(BaseGenerator):
                 beams, completed = self._prune(
                     candidates, completed, beam_width, eos_tokens
                 )
+                # Anytime checkpoint: every step leaves a rankable prefix.
+                pool = completed + [(s, r) for s, r, *_ in beams]
+                if pool:
+                    best_seq, best_welfare = self._best_pair(pool)
+                    self._checkpoint(
+                        best_seq,
+                        welfare=best_welfare,
+                        checkpoint=f"step {step + 1}/{max_tokens}",
+                        steps_done=step + 1,
+                        steps_planned=max_tokens,
+                        beam_width=beam_width,
+                        beam_width_planned=beam_width_full,
+                    )
                 if not beams or step == max_tokens - 1:
                     break
+                if clock.expired():
+                    return self._degrade()
                 # Advance every session slot; slots beyond the surviving
                 # beams repeat the last survivor, proposals ignored.
                 parents: List[int] = []
@@ -166,7 +189,18 @@ class BeamSearchGenerator(BaseGenerator):
 
         statement = self._select_best(completed)
         self.pre_brushup_statement = statement
+        if beam_width < beam_width_full:
+            self._mark_scaled(
+                beam_width=beam_width, beam_width_planned=beam_width_full
+            )
         if cfg.get("brushup", False):
+            if clock.expired():
+                # Skip the brushup pass under pressure: the unbrushed
+                # statement is complete, the extra dispatch is not worth it.
+                spent = dict(self.anytime.budget_spent) if self.anytime else {}
+                spent["brushup_skipped"] = True
+                self._checkpoint(statement, checkpoint="pre-brushup", **spent)
+                return self._degrade()
             statement = brushup_statement_ending(
                 self.backend, statement, seed=seed
             )
@@ -199,7 +233,9 @@ class BeamSearchGenerator(BaseGenerator):
         return new_beams, completed
 
     @staticmethod
-    def _select_best(completed: List[Tuple[str, List[float]]]) -> str:
+    def _best_pair(
+        completed: List[Tuple[str, List[float]]]
+    ) -> Tuple[str, float]:
         filtered = [
             (seq, rewards)
             for seq, rewards in completed
@@ -207,5 +243,9 @@ class BeamSearchGenerator(BaseGenerator):
         ]
         if not filtered:
             filtered = completed
-        best_seq, _ = max(filtered, key=lambda c: min(c[1]))
-        return best_seq.strip()
+        best_seq, best_rewards = max(filtered, key=lambda c: min(c[1]))
+        return best_seq.strip(), float(min(best_rewards))
+
+    @staticmethod
+    def _select_best(completed: List[Tuple[str, List[float]]]) -> str:
+        return BeamSearchGenerator._best_pair(completed)[0]

@@ -34,23 +34,51 @@ from consensus_tpu.ops.welfare import (
 
 
 class BestOfNGenerator(BaseGenerator):
+    method_name = "best_of_n"
+
     def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
         cfg = self.config
         # Config key ``num_best_of_n`` preferred over ``n`` (reference :60-62).
-        n = int(cfg.get("num_best_of_n", cfg.get("n", 3)))
+        n_full = int(cfg.get("num_best_of_n", cfg.get("n", 3)))
+        clock = self.budget_clock
+        # Brownout shrinks N; seeds stay ``seed + i`` so the scaled run is
+        # a strict prefix of the full candidate set.
+        n = clock.scale_int(n_full)
         max_tokens = int(cfg.get("max_tokens", 50))
         temperature = float(cfg.get("temperature", 1.0))
         seed = self.seed
 
+        if clock.expired():
+            return self._degrade()
         candidates = self._generate_candidates(
             issue, agent_opinions, n, max_tokens, temperature, seed
         )
         if not candidates:
             return "[ERROR: Failed to generate any candidates]"
+        # First anytime checkpoint: an unscored candidate beats a 504.
+        self._checkpoint(
+            candidates[0],
+            checkpoint="generated",
+            candidates_generated=len(candidates),
+            candidates_scored=0,
+            n_planned=n_full,
+        )
+        if clock.expired():
+            return self._degrade()
 
         utilities = self.score_candidates(issue, agent_opinions, candidates)
         welfare = egalitarian_welfare(sanitize_utilities(utilities), axis=1)
         best = int(np.argmax(np.asarray(welfare)))
+        self._checkpoint(
+            candidates[best],
+            welfare=float(np.asarray(welfare)[best]),
+            checkpoint="scored",
+            candidates_generated=len(candidates),
+            candidates_scored=len(candidates),
+            n_planned=n_full,
+        )
+        if n < n_full:
+            self._mark_scaled(n_used=n, n_planned=n_full)
         return candidates[best]
 
     # -- steps ---------------------------------------------------------------

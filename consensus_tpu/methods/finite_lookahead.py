@@ -59,10 +59,16 @@ Path = Tuple[List[ScoredCandidate], List[float]]
 
 
 class FiniteLookaheadGenerator(BaseGenerator):
+    method_name = "finite_lookahead"
+
     def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
         cfg = self.config
+        clock = self.budget_clock
         branching = int(cfg.get("branching_factor", 2))
-        max_depth = int(cfg.get("max_depth", 3))
+        max_depth_full = int(cfg.get("max_depth", 3))
+        # Brownout shrinks the lookahead horizon; a shallower tree is still
+        # a valid receding-horizon policy, just more myopic.
+        max_depth = clock.scale_int(max_depth_full)
         max_tokens = int(cfg.get("max_tokens", 50))
         temperature = float(cfg.get("temperature", 1.0))
         seed = self.seed
@@ -75,6 +81,8 @@ class FiniteLookaheadGenerator(BaseGenerator):
         agents = list(agent_opinions.items())
         if not agents:
             return ""
+        if clock.expired():
+            return self._degrade()
 
         system, user = reference_prompt(
             issue, agent_opinions, variant="finite_lookahead"
@@ -101,28 +109,55 @@ class FiniteLookaheadGenerator(BaseGenerator):
         )
 
         statement = ""
+        degraded_exit = False
         try:
             root_proposals = session.propose()[0]
             for step in range(max_tokens):
                 best = self._best_path(
                     session, root_proposals, branching, max_depth, step,
-                    terminators,
+                    terminators, clock=clock,
                 )
                 if best is None:
                     break
-                first = best[0][0]
+                path, sums = best
+                first = path[0]
                 if first.token in terminators:
                     break
                 statement += first.token
+                # Anytime checkpoint: each emitted token extends a valid
+                # (if shorter) statement.
+                self._checkpoint(
+                    statement.strip(),
+                    welfare=float(min(s / len(path) for s in sums)),
+                    checkpoint=f"token {step + 1}/{max_tokens}",
+                    tokens_emitted=step + 1,
+                    tokens_planned=max_tokens,
+                    max_depth=max_depth,
+                    max_depth_planned=max_depth_full,
+                )
                 if step == max_tokens - 1:
+                    break
+                if clock.expired():
+                    degraded_exit = True
                     break
                 root_proposals = session.advance_and_propose([0], [first])[0]
         finally:
             session.close()
 
+        if degraded_exit:
+            return self._degrade()
         statement = statement.strip()
         self.pre_brushup_statement = statement
+        if max_depth < max_depth_full:
+            self._mark_scaled(
+                max_depth=max_depth, max_depth_planned=max_depth_full
+            )
         if cfg.get("brushup", False):
+            if clock.expired():
+                spent = dict(self.anytime.budget_spent) if self.anytime else {}
+                spent["brushup_skipped"] = True
+                self._checkpoint(statement, checkpoint="pre-brushup", **spent)
+                return self._degrade()
             statement = brushup_statement_ending(self.backend, statement, seed=seed)
         return statement
 
@@ -133,10 +168,14 @@ class FiniteLookaheadGenerator(BaseGenerator):
         session, root_proposals: List[ScoredCandidate], branching: int,
         max_depth: int, step: int,
         terminators: frozenset = TERMINATOR_TOKENS,
+        clock=None,
     ):
         """Grow the level-batched tree from the trunk, accumulate per-agent
         logprob sums along every path, and return the max-min mean path
-        (reference :424-536)."""
+        (reference :424-536).  A level is one device dispatch, so the
+        anytime ``clock`` is checked between levels: on expiry the tree
+        stops growing and the best path over the partial tree is returned —
+        every partial tree still ranks complete root-to-leaf prefixes."""
         frontier: List[Path] = []
         finished: List[Path] = []
         for cand in root_proposals[:branching]:
@@ -148,6 +187,8 @@ class FiniteLookaheadGenerator(BaseGenerator):
 
         for depth in range(1, max_depth):
             if not frontier:
+                break
+            if clock is not None and clock.expired():
                 break
             proposals = session.propose_suffixes(
                 [path for path, _ in frontier], salt=step * max_depth + depth

@@ -133,10 +133,22 @@ def _revision_prompt(
 
 
 class HabermasMachineGenerator(BaseGenerator):
+    method_name = "habermas_machine"
+
     def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
         cfg = self.config
-        num_candidates = int(cfg.get("num_candidates", 3))
-        num_rounds = int(cfg.get("num_rounds", 1))
+        clock = self.budget_clock
+        num_candidates_full = int(cfg.get("num_candidates", 3))
+        num_rounds_full = int(cfg.get("num_rounds", 1))
+        # Brownout shrinks the deliberation: fewer drafted candidates and
+        # fewer critique/revise rounds (rounds may scale to 0 — the phase-1
+        # Schulze winner is already a valid consensus statement).
+        num_candidates = clock.scale_int(num_candidates_full)
+        num_rounds = (
+            int(num_rounds_full * clock.scale)
+            if clock.scale < 1.0
+            else num_rounds_full
+        )
         self._num_retries = int(cfg.get("num_retries_on_error", 1))
         self._tie_breaking = cfg.get("tie_breaking_method", "random")
         self._max_tokens = int(cfg.get("max_tokens", 700))
@@ -162,11 +174,26 @@ class HabermasMachineGenerator(BaseGenerator):
         self.agent_rankings: Dict[str, Optional[np.ndarray]] = {}
         self.all_round_data: List[Dict] = []
 
+        if clock.expired():
+            return self._degrade()
+
         # Phase 1: draft candidates.
         candidates = self._draft_candidates(issue, opinions, num_candidates)
         if not candidates:
             return "[ERROR: Habermas Machine failed to generate candidates]"
         self.candidate_statements = candidates
+        # First anytime checkpoint: an unranked draft beats a 504.
+        self._checkpoint(
+            candidates[0],
+            checkpoint="drafted",
+            phases_done=1,
+            rounds_done=0,
+            rounds_planned=num_rounds_full,
+            num_candidates=num_candidates,
+            num_candidates_planned=num_candidates_full,
+        )
+        if clock.expired():
+            return self._degrade()
 
         # Phase 2+3: rank + aggregate.
         rankings = self._rank_all(issue, agent_opinions, candidates, round_num=0)
@@ -174,9 +201,21 @@ class HabermasMachineGenerator(BaseGenerator):
         winner = self._winner(candidates, rankings, round_num=0)
         if winner is None:
             return candidates[0]
+        self._checkpoint(
+            winner,
+            checkpoint="round 0 winner",
+            phases_done=3,
+            rounds_done=0,
+            rounds_planned=num_rounds_full,
+            num_candidates=num_candidates,
+            num_candidates_planned=num_candidates_full,
+        )
 
-        # Phase 4: critique/revise rounds.
+        # Phase 4: critique/revise rounds.  Checkpoints land at round
+        # boundaries — each round's winner is a complete statement.
         for round_num in range(num_rounds):
+            if clock.expired():
+                return self._degrade()
             round_data: Dict = {"round": round_num + 1, "winner_before": winner}
             critiques = self._critiques(issue, agent_opinions, winner, round_num)
             round_data["agent_critiques"] = dict(zip(agent_opinions, critiques))
@@ -207,7 +246,23 @@ class HabermasMachineGenerator(BaseGenerator):
                 self.agent_rankings = rankings
             round_data["winner_after"] = winner
             self.all_round_data.append(round_data)
+            self._checkpoint(
+                winner,
+                checkpoint=f"round {round_num + 1} winner",
+                phases_done=3 + 3 * (round_num + 1),
+                rounds_done=round_num + 1,
+                rounds_planned=num_rounds_full,
+                num_candidates=num_candidates,
+                num_candidates_planned=num_candidates_full,
+            )
 
+        if num_candidates < num_candidates_full or num_rounds < num_rounds_full:
+            self._mark_scaled(
+                num_candidates=num_candidates,
+                num_candidates_planned=num_candidates_full,
+                num_rounds=num_rounds,
+                num_rounds_planned=num_rounds_full,
+            )
         return winner
 
     # -- seeds ---------------------------------------------------------------

@@ -120,9 +120,15 @@ class Node:
 
 
 class MCTSGenerator(BaseGenerator):
+    method_name = "mcts"
+
     def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
         cfg = self.config
-        self._num_simulations = int(cfg.get("num_simulations", 50))
+        clock = self.budget_clock
+        self._num_simulations_full = int(cfg.get("num_simulations", 50))
+        # Brownout shrinks the per-token simulation budget; fewer sims =
+        # noisier visit counts, same estimator.
+        self._num_simulations = clock.scale_int(self._num_simulations_full)
         self._c = float(cfg.get("exploration_constant", 1.414))
         max_tokens = int(cfg.get("max_tokens", 100))
         self._width = int(cfg.get("expansion_sample_width", 5))
@@ -139,6 +145,8 @@ class MCTSGenerator(BaseGenerator):
         agents = list(agent_opinions.items())
         if not agents:
             return ""
+        if clock.expired():
+            return self._degrade()
         self._n_agents = len(agents)
 
         system, user = reference_prompt(issue, agent_opinions, variant="mcts")
@@ -202,6 +210,7 @@ class MCTSGenerator(BaseGenerator):
         }
 
         dispatches_before = getattr(self._session, "dispatch_count", 0)
+        self._expired_exit = False
         try:
             statement = self._search(max_tokens)
         finally:
@@ -212,8 +221,22 @@ class MCTSGenerator(BaseGenerator):
         self.search_stats["device_dispatches"] = dispatches
         obs_dispatches.inc(dispatches)
         obs_statements.inc()
+        if self._expired_exit:
+            # The search committed what it could; skip brushup and return
+            # the latest checkpoint tagged degraded.
+            return self._degrade()
+        if self._num_simulations < self._num_simulations_full:
+            self._mark_scaled(
+                num_simulations=self._num_simulations,
+                num_simulations_planned=self._num_simulations_full,
+            )
         self.pre_brushup_statement = statement
         if cfg.get("brushup", False):
+            if clock.expired():
+                spent = dict(self.anytime.budget_spent) if self.anytime else {}
+                spent["brushup_skipped"] = True
+                self._checkpoint(statement, checkpoint="pre-brushup", **spent)
+                return self._degrade()
             statement = brushup_statement_ending(
                 self.backend, statement, seed=self.seed
             )
@@ -227,12 +250,37 @@ class MCTSGenerator(BaseGenerator):
         root = Node(None, None)
         root.untried = list(self._session.propose()[0])
 
+        clock = self.budget_clock
         for step in range(max_tokens):
             sims_done = 0
             while sims_done < self._num_simulations:
                 width = min(self._wave_size, self._num_simulations - sims_done)
                 self._run_wave(root, width, trunk_sums)
                 sims_done += width
+                if not clock.bounded:
+                    continue
+                # Anytime checkpoint (bounded clocks only — skips the
+                # per-wave argmax on the hot unbounded path): the search's
+                # commit-if-stopped-now statement is the trunk plus the
+                # currently most-visited child.  On expiry the partial
+                # visit counts still pick a token — commit it, then exit
+                # degraded after this step.
+                tentative = max(
+                    root.children.values(), key=lambda n: n.visits,
+                ) if root.children else None
+                if tentative is not None:
+                    self._checkpoint(
+                        (statement + tentative.cand.token).strip(),
+                        welfare=float(tentative.value),
+                        checkpoint=f"token {step + 1}, {sims_done} sims",
+                        tokens_committed=step,
+                        sims_done=sims_done,
+                        sims_planned=self._num_simulations,
+                        sims_planned_full=self._num_simulations_full,
+                    )
+                if clock.expired():
+                    self._expired_exit = True
+                    break
 
             self.search_stats["visit_log"].append(
                 sorted(
@@ -253,6 +301,8 @@ class MCTSGenerator(BaseGenerator):
             chosen = best.cand
             best.parent = None  # detach (reference :1005-1006)
             root = best
+            if self._expired_exit:
+                break
             if root.is_terminal or step == max_tokens - 1:
                 break
             new_proposals = self._session.advance_and_propose([0], [chosen])[0]

@@ -16,6 +16,8 @@ MISSING_STATEMENT = "[ERROR: Predefined statement not found in config]"
 
 
 class PredefinedStatementGenerator(BaseGenerator):
+    method_name = "predefined"
+
     def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
         statement = self.config.get("predefined_statement")
         return statement if statement is not None else MISSING_STATEMENT

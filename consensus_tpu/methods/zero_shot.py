@@ -17,6 +17,9 @@ from consensus_tpu.methods.prompts import clean_statement, reference_prompt
 
 
 class ZeroShotGenerator(BaseGenerator):
+    # Single indivisible generation: no anytime seam, nothing to scale.
+    method_name = "zero_shot"
+
     def generate_statement(self, issue: str, agent_opinions: Dict[str, str]) -> str:
         system, user = reference_prompt(issue, agent_opinions)
         result = self.backend.generate(

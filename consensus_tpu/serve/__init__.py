@@ -14,6 +14,7 @@ traffic").  The layering, front to back:
 server; ``serve.loadgen`` replays AAMAS scenarios against it.
 """
 
+from consensus_tpu.serve.brownout import BrownoutController  # noqa: F401
 from consensus_tpu.serve.http_frontend import ConsensusServer  # noqa: F401
 from consensus_tpu.serve.scheduler import (  # noqa: F401
     RequestScheduler,
@@ -43,6 +44,9 @@ def create_server(
     registry=None,
     fault_plan=None,
     supervise=None,
+    brownout: bool = False,
+    target_p95_ms=None,
+    anytime_margin_s: float = 0.2,
 ) -> ConsensusServer:
     """Wire backend → service → scheduler → HTTP server (not yet started).
 
@@ -50,13 +54,28 @@ def create_server(
     fault-tolerance stack over the engine via
     :func:`consensus_tpu.backends.wrap_backend`; a supervised engine's
     circuit breaker is picked up by the scheduler's admission control and
-    surfaced in ``/healthz``."""
+    surfaced in ``/healthz``.
+
+    ``brownout=True`` installs a :class:`BrownoutController`: under load
+    pressure, newly dispatched requests run at a scaled-down search budget
+    (responses tagged ``degraded``) instead of queueing into 504s.
+    ``target_p95_ms`` adds a latency-SLO term to the pressure signal.
+    Defaults OFF so a quiet server's responses stay byte-identical to
+    offline Experiment runs (pinned in tests/test_serve.py)."""
     from consensus_tpu.backends import get_backend, wrap_backend
 
     engine = get_backend(backend, **(backend_options or {}))
     if fault_plan is not None or supervise:
         engine = wrap_backend(
             engine, fault_plan=fault_plan, supervise=supervise,
+            registry=registry,
+        )
+    controller = None
+    if brownout:
+        controller = BrownoutController(
+            target_p95_s=(
+                target_p95_ms / 1000.0 if target_p95_ms else None
+            ),
             registry=registry,
         )
     service = ConsensusService(engine, generation_model=generation_model)
@@ -69,5 +88,7 @@ def create_server(
         max_retries=max_retries,
         flush_ms=flush_ms,
         registry=registry,
+        brownout=controller,
+        anytime_margin_s=anytime_margin_s,
     )
     return ConsensusServer(scheduler, host=host, port=port, registry=registry)

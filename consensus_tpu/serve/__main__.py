@@ -52,6 +52,14 @@ def main(argv=None) -> int:
     parser.add_argument("--flush-ms", type=float, default=10.0,
                         help="BatchingBackend quiescence window (default: 10)")
     parser.add_argument("--generation-model", default="")
+    parser.add_argument("--brownout", action="store_true",
+                        help="enable the brownout controller: under load "
+                             "pressure, scale down per-request search "
+                             "budgets (degraded answers) instead of "
+                             "timing out")
+    parser.add_argument("--target-p95-ms", type=float, default=None,
+                        help="latency SLO fed into the brownout pressure "
+                             "signal (implies --brownout)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -73,6 +81,8 @@ def main(argv=None) -> int:
         max_retries=args.max_retries,
         flush_ms=args.flush_ms,
         generation_model=args.generation_model,
+        brownout=args.brownout or args.target_p95_ms is not None,
+        target_p95_ms=args.target_p95_ms,
     )
     stop = threading.Event()
 
@@ -91,6 +101,7 @@ def main(argv=None) -> int:
         "backend": args.backend,
         "max_queue_depth": args.max_queue_depth,
         "max_inflight": args.max_inflight,
+        "brownout": args.brownout or args.target_p95_ms is not None,
     }))
     try:
         stop.wait()

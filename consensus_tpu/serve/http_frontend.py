@@ -7,8 +7,12 @@ dependencies) in front of :class:`RequestScheduler`:
   structured JSON (``{"error": {"type", "message", ...}}``) with the HTTP
   status carrying the overload semantics: 400 validation, 429 admission
   rejection (with ``Retry-After``), 503 circuit-breaker open
-  (``Retry-After`` = breaker cooldown), 504 deadline expiry, 500 terminal
-  backend failure.
+  (``Retry-After`` = breaker cooldown), 504 deadline expiry with NO
+  completed search wave (``Retry-After`` hint attached), 500 terminal
+  backend failure.  A deadline expiry where at least one wave completed
+  returns **200** with the anytime partial and ``"degraded": true`` —
+  graceful degradation trades answer quality for availability, never the
+  other way around.
 * ``GET /healthz`` — queue depth, in-flight count, drain state, backend
   liveness, device-batch accounting (the coalescing proof surface).
 * ``GET /metrics`` — Prometheus text exposition straight from the obs
@@ -41,8 +45,17 @@ logger = logging.getLogger(__name__)
 #: its ticket — covers scheduler bookkeeping so the worker, not the
 #: handler's stopwatch, decides borderline timeouts.
 _WAIT_GRACE_S = 0.25
+#: After cancelling an expired ticket, how long the handler lingers for the
+#: worker to surface an anytime partial (the method notices the expired
+#: BudgetClock at its next checkpoint — at most one wave away — and returns
+#: best-so-far tagged ``degraded``).  Only when NO wave completed does the
+#: 504 fire.
+_DEGRADED_GRACE_S = 2.0
 #: Ticket wait for requests with no deadline at all.
 _UNBOUNDED_WAIT_S = 3600.0
+#: Retry-After hint on 504s: the deadline was the client's own budget, so
+#: there is no server cooldown to report — suggest a short backoff.
+_TIMEOUT_RETRY_AFTER_S = 1
 
 
 class ConsensusHTTPServer(ThreadingHTTPServer):
@@ -109,16 +122,23 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         )
         if not ticket.wait(timeout=max(0.0, wait_s)):
             # Cooperative cancellation: a queued ticket dies at pop; a
-            # running one completes server-side but is counted as timeout.
+            # running one sees the expired BudgetClock (or the dropped batch
+            # entry) at its next checkpoint and returns its best-so-far
+            # statement tagged ``degraded`` — so linger briefly for that
+            # partial before conceding a 504.  Anytime over unavailable.
             ticket.cancel()
-            self._send_error_json(
-                504, "timeout",
-                "deadline expired before the request completed")
-            return
+            if not ticket.wait(timeout=_DEGRADED_GRACE_S):
+                self._send_error_json(
+                    504, "timeout",
+                    "deadline expired before any search wave completed",
+                    headers={"Retry-After": str(_TIMEOUT_RETRY_AFTER_S)})
+                return
         try:
             result = ticket.result()
         except RequestTimeout as exc:
-            self._send_error_json(504, "timeout", str(exc))
+            self._send_error_json(
+                504, "timeout", str(exc),
+                headers={"Retry-After": str(_TIMEOUT_RETRY_AFTER_S)})
             return
         except SchedulerRejected as exc:
             self._send_rejection(exc)
@@ -174,10 +194,11 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         body = json.dumps(payload).encode("utf-8")
         self._send_bytes(status, body, "application/json", headers)
 
-    def _send_error_json(self, status: int, error_type: str,
-                         message: str) -> None:
+    def _send_error_json(self, status: int, error_type: str, message: str,
+                         headers: Optional[Dict[str, str]] = None) -> None:
         self._send_json(status, {"error": {"type": error_type,
-                                           "message": message}})
+                                           "message": message}},
+                        headers=headers)
 
     def _send_bytes(self, status: int, body: bytes, content_type: str,
                     headers: Optional[Dict[str, str]] = None) -> None:

@@ -61,6 +61,8 @@ class RequestOutcome:
     latency_s: float
     error_type: str = ""
     statement: str = ""
+    #: True when the 200 carried an anytime partial / browned-out result.
+    degraded: bool = False
 
 
 def _percentile(sorted_values: List[float], q: float) -> float:
@@ -107,6 +109,7 @@ def run_loadgen(
                     status=response.status,
                     latency_s=time.perf_counter() - start,
                     statement=data.get("statement", ""),
+                    degraded=bool(data.get("degraded", False)),
                 )
         except urllib.error.HTTPError as exc:
             try:
@@ -158,8 +161,9 @@ def run_loadgen(
         buckets[classify(outcome)].append(outcome)
     ok, rejected = buckets["ok"], buckets["rejected"]
     timeouts, failed = buckets["timeout"], buckets["failed"]
+    degraded = [o for o in ok if o.degraded]
     latencies = sorted(o.latency_s for o in ok)
-    return {
+    report: Dict[str, Any] = {
         "requests": len(payloads),
         "offered_rate_rps": rate_rps,
         "wall_s": round(wall_s, 3),
@@ -173,6 +177,11 @@ def run_loadgen(
         # Availability under faults: fraction of offered requests that got
         # a 200 — the headline chaos/SLO number.
         "availability": round(len(ok) / len(payloads), 4) if payloads else 0.0,
+        # Brownout surface: how many 200s were anytime partials / ran at a
+        # reduced search budget — the price paid for the availability above.
+        "degraded": len(degraded),
+        "degraded_fraction": round(len(degraded) / len(payloads), 4)
+        if payloads else 0.0,
         "latency_ms": {
             "p50": round(_percentile(latencies, 0.50) * 1e3, 2),
             "p95": round(_percentile(latencies, 0.95) * 1e3, 2),
@@ -181,6 +190,27 @@ def run_loadgen(
         },
         "outcomes": done,
     }
+    tier_counts = fetch_tier_counts(base_url)
+    if tier_counts is not None:
+        report["tier_request_counts"] = tier_counts
+    return report
+
+
+def fetch_tier_counts(base_url: str) -> Optional[Dict[str, int]]:
+    """Per-tier dispatch counts from the server's /healthz brownout
+    snapshot; None when the controller is disabled or /healthz is down."""
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/healthz", timeout=5.0
+        ) as response:
+            health = json.loads(response.read().decode("utf-8"))
+    except Exception:
+        return None
+    brownout = health.get("brownout")
+    if not isinstance(brownout, dict):
+        return None
+    counts = brownout.get("tier_request_counts")
+    return dict(counts) if isinstance(counts, dict) else None
 
 
 def report_json(report: Dict[str, Any]) -> str:

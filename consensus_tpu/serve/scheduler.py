@@ -38,6 +38,7 @@ Obs families (land in ``metrics.json`` / ``metrics.prom`` / ``/metrics``):
 from __future__ import annotations
 
 import collections
+import inspect
 import logging
 import threading
 import time
@@ -45,7 +46,9 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 
 from consensus_tpu.backends.base import Backend, TransientBackendError
 from consensus_tpu.backends.batching import BatchingBackend
+from consensus_tpu.methods.anytime import BudgetClock, BudgetExpired
 from consensus_tpu.obs.metrics import Registry, get_registry
+from consensus_tpu.serve.brownout import BrownoutController
 
 logger = logging.getLogger(__name__)
 
@@ -85,7 +88,9 @@ class Ticket:
         self.deadline = deadline  # monotonic seconds, None = no deadline
         self.submitted = time.monotonic()
         self.attempts = 0
-        self.outcome: Optional[str] = None  # "ok" | "timeout" | "failed"
+        # "ok" | "degraded" (anytime partial / browned-out budget) |
+        # "timeout" | "failed"
+        self.outcome: Optional[str] = None
         self._value: Any = None
         self._error: Optional[BaseException] = None
         self._done = threading.Event()
@@ -150,10 +155,29 @@ class RequestScheduler:
         retry_backoff_s: float = 0.05,
         flush_ms: float = 10.0,
         registry: Optional[Registry] = None,
+        brownout: Optional[BrownoutController] = None,
+        anytime_margin_s: float = 0.2,
     ):
         if max_queue_depth < 1 or max_inflight < 1:
             raise ValueError("max_queue_depth and max_inflight must be >= 1")
         self.handler = handler
+        #: Graceful degradation (both OFF by default — full-budget serving
+        #: is byte-identical to pre-brownout builds):
+        #: ``brownout`` maps load pressure to the budget scale stamped on
+        #: each dispatched ticket's BudgetClock; ``anytime_margin_s`` is how
+        #: far BEFORE the ticket deadline the clock expires, buying the
+        #: method time to surface its best-so-far statement while the HTTP
+        #: waiter is still listening.
+        self.brownout = brownout
+        self.anytime_margin_s = float(anytime_margin_s)
+        #: Clocks are only built for handlers that accept them — existing
+        #: ``(request, backend)`` handlers keep their exact semantics.
+        try:
+            self._handler_takes_clock = (
+                "budget_clock" in inspect.signature(handler).parameters
+            )
+        except (TypeError, ValueError):
+            self._handler_takes_clock = False
         self.inner_backend = backend
         #: Supervised backends expose their breaker; admission consults it
         #: so an open breaker sheds load BEFORE requests queue up behind a
@@ -202,6 +226,10 @@ class RequestScheduler:
         self._m_failed = reg.counter(
             "serve_failed_total",
             "Requests that terminally failed after exhausting retries.")
+        self._m_degraded = reg.counter(
+            "serve_degraded_total",
+            "Requests resolved with a degraded (anytime partial or "
+            "budget-scaled) statement instead of a timeout/full result.")
 
         self._lock = threading.Lock()
         self._work_cv = threading.Condition(self._lock)
@@ -297,7 +325,54 @@ class RequestScheduler:
             self._m_accepted.inc()
             self._m_queue_depth.set(len(self._queue))
             self._work_cv.notify()
+        self._update_brownout()
         return ticket
+
+    def _update_brownout(self) -> None:
+        """Feed the live load signals to the controller (no-op when brownout
+        is disabled).  Called outside ``_lock``."""
+        if self.brownout is None:
+            return
+        breaker_state = None
+        if self.circuit_breaker is not None:
+            breaker_state = self.circuit_breaker.snapshot().get("state")
+        with self._lock:
+            queue_depth = len(self._queue)
+            inflight = self._inflight_count
+        self.brownout.update(
+            queue_depth=queue_depth,
+            max_queue_depth=self.max_queue_depth,
+            inflight=inflight,
+            max_inflight=self.max_inflight,
+            breaker_state=breaker_state,
+        )
+
+    def _build_clock(self, ticket: Ticket) -> Optional[BudgetClock]:
+        """Per-request BudgetClock: remaining deadline minus the anytime
+        margin (so partials surface while the waiter still listens), the
+        ticket's cancellation flag, and the current brownout tier's scale."""
+        if not self._handler_takes_clock:
+            return None
+        scale, tier = 1.0, None
+        if self.brownout is not None:
+            self._update_brownout()
+            tier = self.brownout.tier
+            scale = self.brownout.tier_scales[tier]
+            self.brownout.note_dispatch()
+        deadline = None
+        remaining = ticket.remaining()
+        if remaining is not None:
+            deadline = time.monotonic() + remaining - self.anytime_margin_s
+        if deadline is None and scale >= 1.0 and tier in (None, 0):
+            # Unbounded, unscaled: hand the method its default clock (built
+            # from config) rather than pinning an inert one.
+            return None
+        return BudgetClock(
+            deadline=deadline,
+            scale=scale,
+            cancelled=lambda: ticket.cancelled,
+            tier=tier,
+        )
 
     def stats(self) -> Dict[str, Any]:
         """Live occupancy for /healthz."""
@@ -313,6 +388,8 @@ class RequestScheduler:
             }
         if self.circuit_breaker is not None:
             stats["circuit_breaker"] = self.circuit_breaker.snapshot()
+        if self.brownout is not None:
+            stats["brownout"] = self.brownout.snapshot()
         return stats
 
     # -- workers -----------------------------------------------------------
@@ -345,18 +422,40 @@ class RequestScheduler:
 
     def _run_ticket(self, ticket: Ticket) -> None:
         method = getattr(ticket.request, "method", "unknown")
+        self._update_brownout()
         if ticket.cancelled or ticket.expired():
             # Died in the queue: the cheap overload outcome — no device
-            # work was wasted on it.
+            # work was wasted on it (and no wave ran, so there is no
+            # partial to degrade to).
             self._m_timeout.inc()
             self._finish(ticket, method, "timeout",
                          error=RequestTimeout("deadline expired in queue"))
             return
+        clock = self._build_clock(ticket)
+        handler_kwargs = (
+            {"budget_clock": clock} if self._handler_takes_clock else {}
+        )
         while True:
             ticket.attempts += 1
             try:
-                with self.batching.session():
-                    value = self.handler(ticket.request, self.batching)
+                # The ticket's cancellation flag rides into the batching
+                # layer: queued device calls of an abandoned ticket are
+                # dropped at the flush snapshot (RequestCancelled) instead
+                # of spending device time co-batched with live requests.
+                with self.batching.session(cancelled=lambda: ticket.cancelled):
+                    value = self.handler(
+                        ticket.request, self.batching, **handler_kwargs
+                    )
+            except BudgetExpired as exc:
+                # The budget died before ANY wave completed — nothing to
+                # degrade to; terminal timeout, exactly the pre-anytime
+                # outcome.
+                self._m_timeout.inc()
+                self._finish(ticket, method, "timeout",
+                             error=RequestTimeout(
+                                 f"budget expired before the first "
+                                 f"{exc.method} wave ({exc.reason})"))
+                return
             except Exception as exc:
                 if ticket.cancelled or ticket.expired():
                     # The failure is moot: the deadline already passed, so
@@ -383,13 +482,21 @@ class RequestScheduler:
                     backoff = min(backoff, max(0.0, remaining))
                 time.sleep(backoff)
                 continue
-            if ticket.cancelled or ticket.expired():
-                # Completed past its deadline: the waiter is gone; report
-                # the truth (timeout) rather than a result nobody read.
+            degraded = isinstance(value, dict) and value.get("degraded")
+            if (ticket.cancelled or ticket.expired()) and not degraded:
+                # A FULL result completed past its deadline: the waiter is
+                # gone; report the truth (timeout) rather than a result
+                # nobody read.  Degraded results are exempt — they exist
+                # precisely to be delivered at/after the deadline, and the
+                # HTTP waiter grants a grace window to collect them.
                 self._m_timeout.inc()
                 self._finish(ticket, method, "timeout",
                              error=RequestTimeout(
                                  "completed after deadline; result discarded"))
+                return
+            if degraded:
+                self._m_degraded.inc()
+                self._finish(ticket, method, "degraded", value=value)
                 return
             self._finish(ticket, method, "ok", value=value)
             return
@@ -406,7 +513,12 @@ class RequestScheduler:
     def _finish(self, ticket: Ticket, method: str, outcome: str,
                 value: Any = None,
                 error: Optional[BaseException] = None) -> None:
-        self._m_latency.labels(method, outcome).observe(
-            time.monotonic() - ticket.submitted
-        )
+        elapsed = time.monotonic() - ticket.submitted
+        self._m_latency.labels(method, outcome).observe(elapsed)
+        if self.brownout is not None and outcome in (
+            "ok", "degraded", "timeout"
+        ):
+            # Timeouts feed the tracker too: they ARE the latency tail the
+            # controller exists to shave.
+            self.brownout.record_latency(elapsed)
         ticket._finish(outcome, value=value, error=error)

@@ -24,8 +24,9 @@ import dataclasses
 import time
 from typing import Any, Dict, List, Optional
 
-from consensus_tpu.backends.base import Backend
+from consensus_tpu.backends.base import Backend, RequestCancelled
 from consensus_tpu.methods import GENERATOR_MAP, get_method_generator
+from consensus_tpu.methods.anytime import BudgetClock
 
 #: Params that must be scalars of these types when present.
 _PARAM_SCALARS = (str, int, float, bool)
@@ -197,7 +198,15 @@ class ConsensusService:
         self,
         request: ConsensusRequest,
         backend: Optional[Backend] = None,
+        budget_clock: Optional[BudgetClock] = None,
     ) -> Dict[str, Any]:
+        """One request → one response dict.
+
+        ``budget_clock`` (scheduler-injected) bounds the method's search:
+        on expiry the method returns its best-so-far statement and the
+        response is tagged ``degraded=true`` with ``budget_spent``
+        accounting; absent a clock the method runs its full configured
+        budget and the response is byte-identical to pre-anytime builds."""
         engine = backend if backend is not None else self.backend
         run_config = dict(request.params)
         run_config["seed"] = request.seed
@@ -205,21 +214,49 @@ class ConsensusService:
         generator = get_method_generator(
             request.method, engine, run_config, self.generation_model
         )
-        statement = generator.generate_statement(
-            request.issue, request.agent_opinions
-        )
+        if budget_clock is not None:
+            generator.budget_clock = budget_clock
+        try:
+            statement = generator.generate_statement(
+                request.issue, request.agent_opinions
+            )
+        except RequestCancelled:
+            # The batching layer dropped one of this request's device calls
+            # (ticket cancelled before dispatch).  If a wave already
+            # completed, salvage its checkpoint instead of wasting the work;
+            # with nothing banked, _degrade raises BudgetExpired and the
+            # scheduler reports the timeout.
+            if generator.anytime is None:
+                raise
+            if budget_clock is not None:
+                budget_clock.expired()  # latch the "cancelled" reason
+            statement = generator._degrade()
         response: Dict[str, Any] = {
             "request_id": request.request_id,
             "method": request.method,
             "seed": request.seed,
             "statement": statement,
         }
+        if generator.degraded:
+            response["degraded"] = True
+            response["degraded_reason"] = generator.degraded_reason
+            response["budget_spent"] = dict(generator.budget_spent)
         if generator.pre_brushup_statement is not None and request.params.get(
             "brushup", False
         ):
             response["pre_brushup_statement"] = generator.pre_brushup_statement
-        if request.evaluate:
-            response.update(self._evaluate(request, statement, engine))
+        # Evaluation is skipped when the budget died mid-search (spending
+        # MORE device time after the deadline defeats the early exit);
+        # budget_scaled runs completed with headroom, so they still score.
+        if request.evaluate and generator.degraded_reason not in (
+            "deadline", "cancelled"
+        ):
+            try:
+                response.update(self._evaluate(request, statement, engine))
+            except RequestCancelled:
+                response.setdefault("degraded", True)
+                response.setdefault("degraded_reason", "cancelled")
+                response["evaluation_skipped"] = "cancelled mid-evaluation"
         response["generation_time_s"] = round(time.perf_counter() - start, 3)
         return response
 

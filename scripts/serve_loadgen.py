@@ -51,6 +51,14 @@ def main(argv=None) -> int:
                         help="(self-contained) worker pool size")
     parser.add_argument("--max-queue-depth", type=int, default=64,
                         help="(self-contained) admission queue bound")
+    parser.add_argument("--brownout", action="store_true",
+                        help="(self-contained) enable the brownout "
+                             "controller: overloaded requests run at a "
+                             "scaled search budget (degraded 200s) instead "
+                             "of timing out")
+    parser.add_argument("--target-p95-ms", type=float, default=None,
+                        help="(self-contained) latency SLO fed into the "
+                             "brownout pressure signal (implies --brownout)")
     parser.add_argument("--metrics-out", default=None,
                         help="write the serve-side registry snapshot delta "
                              "(metrics.json schema) here (self-contained)")
@@ -91,6 +99,8 @@ def main(argv=None) -> int:
             max_inflight=args.max_inflight,
             max_queue_depth=args.max_queue_depth,
             fault_plan=args.fault_plan,
+            brownout=args.brownout or args.target_p95_ms is not None,
+            target_p95_ms=args.target_p95_ms,
         ).start()
         before = get_registry().snapshot()
         try:

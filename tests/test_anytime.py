@@ -1,0 +1,263 @@
+"""Anytime-decoder tests: BudgetClock semantics, per-method mid-run expiry,
+budget scaling, and full-budget bit-identity (the seam must be inert when
+unbounded — pinned here, relied on by tests/test_serve.py's acceptance
+test and tests/golden/).
+"""
+
+import time
+
+import pytest
+
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.experiment import Experiment
+from consensus_tpu.methods import get_method_generator
+from consensus_tpu.methods.anytime import (
+    BudgetClock,
+    BudgetExpired,
+    observe_welfare_gap,
+    record_early_exit,
+)
+from consensus_tpu.obs.metrics import Registry
+
+ISSUE = "Should the city invest in more bike lanes?"
+OPINIONS = {
+    "Agent 1": "Bike lanes make streets safer and should be expanded.",
+    "Agent 2": "Road space is scarce; cars and buses need priority.",
+    "Agent 3": "Invest only where cycling demand is proven.",
+}
+
+#: (method, small-but-multi-wave config) — every search method with a seam.
+METHOD_CONFIGS = [
+    ("best_of_n", {"n": 3, "max_tokens": 16}),
+    ("beam_search", {"beam_width": 2, "max_tokens": 6}),
+    ("finite_lookahead",
+     {"branching_factor": 2, "max_depth": 2, "max_tokens": 6}),
+    ("mcts", {"num_simulations": 4, "expansion_sample_width": 2,
+              "max_tokens": 4, "rollout_depth": 2}),
+    ("habermas_machine", {"num_candidates": 2, "num_rounds": 1,
+                          "max_tokens": 40}),
+]
+
+
+@pytest.fixture()
+def backend():
+    return FakeBackend()
+
+
+class TestBudgetClock:
+    def test_unbounded_never_expires(self):
+        clock = BudgetClock.unbounded()
+        assert not clock.bounded
+        assert not clock.expired()
+        assert clock.reason is None
+        assert clock.remaining() is None
+
+    def test_deadline_expiry_and_stickiness(self):
+        clock = BudgetClock(deadline=time.monotonic() - 0.01)
+        assert clock.expired()
+        assert clock.reason == "deadline"
+        # Sticky: pushing the deadline out does not un-expire it.
+        clock.deadline = time.monotonic() + 60.0
+        assert clock.expired()
+
+    def test_cancellation_probe_and_stickiness(self):
+        flag = {"cancelled": True}
+        clock = BudgetClock(cancelled=lambda: flag["cancelled"])
+        assert clock.bounded
+        assert clock.expired()
+        assert clock.reason == "cancelled"
+        flag["cancelled"] = False  # latch must hold
+        assert clock.expired()
+
+    def test_cancelled_takes_precedence_over_deadline(self):
+        clock = BudgetClock(
+            deadline=time.monotonic() - 1.0, cancelled=lambda: True
+        )
+        assert clock.expired()
+        assert clock.reason == "cancelled"
+
+    def test_scale_validation(self):
+        with pytest.raises(ValueError):
+            BudgetClock(scale=0.0)
+        with pytest.raises(ValueError):
+            BudgetClock(scale=1.5)
+
+    def test_scale_int(self):
+        half = BudgetClock(scale=0.5)
+        assert half.scale_int(4) == 2
+        assert half.scale_int(5) == 3  # ceil
+        assert half.scale_int(1) == 1  # floor at 1
+        assert half.scale_int(0) == 0  # zero budget preserved
+        tiny = BudgetClock(scale=0.01)
+        assert tiny.scale_int(10) == 1  # never degenerates to 0
+        full = BudgetClock.unbounded()
+        assert full.scale_int(7) == 7  # identity
+
+    def test_from_config(self):
+        assert not BudgetClock.from_config({}).bounded
+        clock = BudgetClock.from_config({"budget_s": 60.0,
+                                         "budget_scale": 0.5})
+        assert clock.scale == 0.5
+        remaining = clock.remaining()
+        assert remaining is not None and 0 < remaining <= 60.0
+
+
+class TestObsHelpers:
+    def test_record_early_exit_counts(self):
+        registry = Registry()
+        record_early_exit("mcts", "deadline", registry=registry)
+        record_early_exit("mcts", "deadline", registry=registry)
+        snapshot = registry.snapshot()["families"]
+        series = snapshot["anytime_early_exits_total"]["series"]
+        assert series[0]["value"] == 2
+
+    def test_welfare_gap_clamped_and_recorded(self):
+        registry = Registry()
+        assert observe_welfare_gap(
+            "best_of_n", -1.0, -3.5, registry=registry) == 2.5
+        # A degraded run cannot "beat" its own full-budget search.
+        assert observe_welfare_gap(
+            "best_of_n", -1.0, -0.5, registry=registry) == 0.0
+        assert "degraded_welfare_gap" in registry.snapshot()["families"]
+
+
+class TestFullBudgetIdentity:
+    """The seam must be inert without a bound: injecting an explicit
+    unbounded clock changes nothing, and nothing is tagged degraded."""
+
+    @pytest.mark.parametrize("method,config", METHOD_CONFIGS)
+    def test_unbounded_clock_is_bit_identical(self, method, config):
+        plain = get_method_generator(
+            method, FakeBackend(), {**config, "seed": 7})
+        baseline = plain.generate_statement(ISSUE, OPINIONS)
+        assert not plain.degraded
+
+        clocked = get_method_generator(
+            method, FakeBackend(), {**config, "seed": 7})
+        clocked.budget_clock = BudgetClock.unbounded()
+        assert clocked.generate_statement(ISSUE, OPINIONS) == baseline
+        assert not clocked.degraded
+        assert clocked.budget_spent == {}
+
+
+def _trip_after_calls(backend, extra_calls):
+    """Cancellation probe that fires once ``extra_calls`` more backend
+    calls have completed — deterministic mid-run expiry without clocks."""
+    start = sum(backend.call_counts.values())
+
+    def probe():
+        return sum(backend.call_counts.values()) - start >= extra_calls
+
+    return probe
+
+
+class TestMidRunExpiry:
+    @pytest.mark.parametrize("method,config", METHOD_CONFIGS)
+    def test_degrades_to_checkpoint(self, backend, method, config):
+        generator = get_method_generator(
+            method, backend, {**config, "seed": 7})
+        generator.budget_clock = BudgetClock(
+            cancelled=_trip_after_calls(backend, 1))
+        statement = generator.generate_statement(ISSUE, OPINIONS)
+        assert statement  # a real partial, not an error sentinel
+        assert generator.degraded
+        assert generator.degraded_reason == "cancelled"
+        assert generator.budget_spent  # method-specific accounting present
+        assert generator.anytime is not None
+        assert generator.anytime.checkpoint
+
+    def test_best_of_n_expiry_skips_scoring(self, backend):
+        generator = get_method_generator(
+            "best_of_n", backend, {"n": 3, "max_tokens": 16, "seed": 7})
+        generator.budget_clock = BudgetClock(
+            cancelled=_trip_after_calls(backend, 1))
+        generator.generate_statement(ISSUE, OPINIONS)
+        assert generator.budget_spent["candidates_scored"] == 0
+        assert backend.call_counts["score"] == 0
+
+    def test_born_expired_raises_budget_expired(self, backend):
+        generator = get_method_generator(
+            "best_of_n", backend, {"n": 3, "max_tokens": 16, "seed": 7})
+        generator.budget_clock = BudgetClock(
+            deadline=time.monotonic() - 0.01)
+        with pytest.raises(BudgetExpired) as excinfo:
+            generator.generate_statement(ISSUE, OPINIONS)
+        assert excinfo.value.method == "best_of_n"
+        assert excinfo.value.reason == "deadline"
+        assert backend.call_counts["generate"] == 0  # no device time wasted
+
+    def test_early_exit_counter_incremented(self, backend, monkeypatch):
+        registry = Registry()
+        import consensus_tpu.methods.anytime as anytime_mod
+        monkeypatch.setattr(anytime_mod, "get_registry", lambda: registry)
+        generator = get_method_generator(
+            "best_of_n", backend, {"n": 3, "max_tokens": 16, "seed": 7})
+        generator.budget_clock = BudgetClock(
+            cancelled=_trip_after_calls(backend, 1))
+        generator.generate_statement(ISSUE, OPINIONS)
+        family = registry.snapshot()["families"]["anytime_early_exits_total"]
+        (series,) = family["series"]
+        assert series["labels"] == {"method": "best_of_n",
+                                    "reason": "cancelled"}
+        assert series["value"] == 1
+
+
+class TestBudgetScaling:
+    def test_best_of_n_scaled_equals_explicit_smaller_n(self):
+        """scale=0.5 over n=4 must sample the SAME prefix of candidates as
+        an explicit n=2 run (seeds are seed+i), so statements match."""
+        scaled = get_method_generator(
+            "best_of_n", FakeBackend(),
+            {"n": 4, "max_tokens": 16, "seed": 7, "budget_scale": 0.5})
+        scaled_statement = scaled.generate_statement(ISSUE, OPINIONS)
+        assert scaled.degraded
+        assert scaled.degraded_reason == "budget_scaled"
+        assert scaled.budget_spent["n_used"] == 2
+        assert scaled.budget_spent["n_planned"] == 4
+        assert scaled.budget_spent["budget_scale"] == 0.5
+
+        explicit = get_method_generator(
+            "best_of_n", FakeBackend(), {"n": 2, "max_tokens": 16, "seed": 7})
+        assert scaled_statement == explicit.generate_statement(ISSUE, OPINIONS)
+        assert not explicit.degraded
+
+    def test_mcts_scaled_runs_fewer_sims(self):
+        scaled = get_method_generator(
+            "mcts", FakeBackend(),
+            {"num_simulations": 4, "expansion_sample_width": 2,
+             "max_tokens": 3, "rollout_depth": 2, "seed": 7,
+             "budget_scale": 0.5})
+        statement = scaled.generate_statement(ISSUE, OPINIONS)
+        assert statement
+        assert scaled.degraded
+        assert scaled.degraded_reason == "budget_scaled"
+        assert scaled.budget_spent["num_simulations"] == 2
+        assert scaled.budget_spent["num_simulations_planned"] == 4
+
+
+class TestExperimentDegradedRows:
+    def test_degraded_columns_only_on_degraded_rows(self, tmp_path):
+        """budget_scale in a method section produces degraded-tagged rows;
+        a plain sweep's CSV schema stays exactly historical (no new
+        columns) — the tests/golden/ safety property."""
+        config = {
+            "experiment_name": "anytime_rows",
+            "seed": 42,
+            "num_seeds": 1,
+            "backend": "fake",
+            "scenario": {"issue": ISSUE, "agent_opinions": dict(OPINIONS)},
+            "methods_to_run": ["best_of_n"],
+            "best_of_n": {"n": 4, "max_tokens": 16, "budget_scale": 0.5},
+            "output_dir": str(tmp_path / "scaled"),
+        }
+        frame = Experiment(config).run()
+        assert bool(frame.iloc[0]["degraded"])
+        assert frame.iloc[0]["degraded_reason"] == "budget_scaled"
+        assert "n_used" in frame.iloc[0]["budget_spent"]
+
+        plain = dict(config)
+        plain["best_of_n"] = {"n": 2, "max_tokens": 16}
+        plain["output_dir"] = str(tmp_path / "plain")
+        plain_frame = Experiment(plain).run()
+        for column in ("degraded", "degraded_reason", "budget_spent"):
+            assert column not in plain_frame.columns

@@ -517,3 +517,93 @@ class TestPerKindWakeups:
         waits = series("batching_queue_wait_seconds")
         assert waits[("generate",)]["count"] == 1
         assert waits[("score",)]["count"] == 1
+
+
+class TestSessionCancellation:
+    """The drop-at-flush-snapshot seam (ISSUE 5): a cancelled session's
+    queued calls are withdrawn with RequestCancelled before any device time
+    is spent, the probe is consulted exactly once per entry (in-flight
+    entries always complete), and co-batched siblings' slices are
+    bit-identical to solo execution."""
+
+    def test_cancelled_entry_dropped_sibling_slice_identical(self):
+        from consensus_tpu.backends.base import RequestCancelled
+        from consensus_tpu.obs import Registry
+
+        registry = Registry()
+        counting = CountingBackend()
+        batching = BatchingBackend(
+            counting, flush_ms=50.0, expected_sessions=2, registry=registry
+        )
+        live_request = GenerationRequest(
+            user_prompt="live", max_tokens=4, seed=7)
+        barrier = threading.Barrier(2)
+        out = {}
+
+        def live_worker():
+            with batching.session():
+                barrier.wait()
+                out["live"] = batching.generate([live_request])[0]
+
+        def cancelled_worker():
+            with batching.session(cancelled=lambda: True):
+                barrier.wait()
+                try:
+                    batching.generate([GenerationRequest(
+                        user_prompt="gone", max_tokens=4, seed=8)])
+                except RequestCancelled as exc:
+                    out["cancelled"] = exc
+
+        threads = [
+            threading.Thread(target=live_worker),
+            threading.Thread(target=cancelled_worker),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+
+        assert isinstance(out["cancelled"], RequestCancelled)
+        # The sibling's result is bit-identical to a solo run: the dropped
+        # entry never joined (or perturbed) the merged device batch.
+        solo = FakeBackend().generate([live_request])[0]
+        assert out["live"].text == solo.text
+        families = registry.snapshot()["families"]
+        cancelled = families["batching_cancelled_requests_total"]["series"]
+        assert sum(s["value"] for s in cancelled) == 1
+
+    def test_probe_consulted_once_per_entry_at_snapshot(self):
+        """An entry whose probe is False at the flush snapshot completes
+        normally even if the probe turns True later; the NEXT call of the
+        same session is then dropped."""
+        from consensus_tpu.backends.base import RequestCancelled
+
+        counting = CountingBackend()
+        batching = BatchingBackend(counting, flush_ms=5.0)
+        consults = {"n": 0}
+
+        def probe():
+            consults["n"] += 1
+            return consults["n"] > 1  # False exactly once: the 1st snapshot
+
+        with batching.session(cancelled=probe):
+            first = batching.generate(
+                [GenerationRequest(user_prompt="a", max_tokens=4, seed=1)]
+            )
+            assert first[0].text  # in-flight-at-snapshot work completes
+            with pytest.raises(RequestCancelled):
+                batching.generate(
+                    [GenerationRequest(user_prompt="b", max_tokens=4, seed=2)]
+                )
+        assert counting.batches["generate"] == 1  # 2nd call: no device time
+
+    def test_broken_probe_treated_as_not_cancelled(self):
+        def bad_probe():
+            raise RuntimeError("probe exploded")
+
+        batching = BatchingBackend(CountingBackend(), flush_ms=5.0)
+        with batching.session(cancelled=bad_probe):
+            results = batching.generate(
+                [GenerationRequest(user_prompt="x", max_tokens=4, seed=3)]
+            )
+        assert results[0].text  # the flush survived and dispatched

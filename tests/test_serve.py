@@ -603,3 +603,109 @@ class TestServingAcceptance:
         # Mixed-kind serving load keeps completion wakeups surgical.
         assert _counter_total(
             registry, "batching_spurious_wakeups_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation surface (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+
+class TestDegradationSurface:
+    def test_504_carries_retry_after(self):
+        """A deadline expiry with no completed wave stays a 504 — now with
+        a Retry-After hint (the anytime clock is born expired: the 0.05 s
+        budget is smaller than the anytime margin, so BudgetExpired fires
+        before any device work)."""
+        instance = create_server(
+            backend=SlowCountingBackend(delay_s=0.5), port=0,
+            max_inflight=1, registry=Registry(),
+        ).start()
+        try:
+            request = urllib.request.Request(
+                instance.base_url + "/v1/consensus",
+                data=json.dumps({
+                    "issue": ISSUE, "agent_opinions": OPINIONS,
+                    "method": "best_of_n", "params": PARAMS, "seed": 1,
+                    "evaluate": False, "timeout_s": 0.05,
+                }).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as excinfo:
+                urllib.request.urlopen(request, timeout=30.0)
+            assert excinfo.value.code == 504
+            assert excinfo.value.headers["Retry-After"] is not None
+            body = json.loads(excinfo.value.read().decode())
+            assert body["error"]["type"] == "timeout"
+        finally:
+            instance.stop()
+
+    def test_cancelled_ticket_mid_wave_sibling_unaffected(self):
+        """Cancel a ticket while its search is mid-flight in the shared
+        BatchingBackend: the cancelled request resolves to its anytime
+        partial (outcome "degraded"), and a co-batched sibling's statement
+        stays byte-identical to a solo run — a cancelled ticket in a merged
+        batch must never corrupt its siblings."""
+        from consensus_tpu.methods import get_method_generator
+
+        slow = SlowCountingBackend(delay_s=0.05)
+        service = ConsensusService(slow)
+        scheduler = RequestScheduler(
+            service.run, slow, max_queue_depth=8, max_inflight=2,
+            default_timeout_s=60.0, registry=Registry(), flush_ms=20.0,
+        )
+        scheduler.start()
+        try:
+            long_ticket = scheduler.submit(_request(
+                seed=5, method="beam_search",
+                params={"beam_width": 2, "max_tokens": 30}))
+            sibling_params = {"n": 4, "max_tokens": 24}
+            sibling_ticket = scheduler.submit(_request(
+                seed=77, params=dict(sibling_params)))
+            time.sleep(0.4)  # both in flight, sharing merged batches
+            long_ticket.cancel()
+            assert sibling_ticket.wait(timeout=30.0)
+            assert long_ticket.wait(timeout=30.0)
+        finally:
+            scheduler.shutdown(drain=True, timeout=30.0)
+
+        # The sibling is untouched by its co-batched neighbour's death.
+        assert sibling_ticket.outcome == "ok"
+        expected = get_method_generator(
+            "best_of_n", FakeBackend(), {**sibling_params, "seed": 77}
+        ).generate_statement(ISSUE, OPINIONS)
+        assert sibling_ticket.result()["statement"] == expected
+
+        # The cancelled search surfaced its best-so-far wave.
+        assert long_ticket.outcome == "degraded"
+        value = long_ticket.result()
+        assert value["degraded"] is True
+        assert value["degraded_reason"] == "cancelled"
+        assert value["statement"]
+
+    def test_untagged_late_success_still_discarded(self):
+        """A FULL (non-degraded) result that completes after cancellation
+        is still reported as a timeout — only degraded-tagged values earn
+        late delivery."""
+        release = threading.Event()
+
+        def slow_handler(request, backend):
+            release.wait(timeout=10.0)
+            return {"statement": "too late", "seed": request.seed}
+
+        scheduler = RequestScheduler(
+            slow_handler, FakeBackend(), max_queue_depth=4, max_inflight=1,
+            default_timeout_s=30.0, registry=Registry(),
+        )
+        scheduler.start()
+        try:
+            ticket = scheduler.submit(_request(seed=1))
+            time.sleep(0.05)  # let the worker enter the handler
+            ticket.cancel()
+            release.set()
+            assert ticket.wait(timeout=10.0)
+            assert ticket.outcome == "timeout"
+            with pytest.raises(RequestTimeout):
+                ticket.result()
+        finally:
+            scheduler.shutdown(drain=True, timeout=10.0)
