@@ -130,6 +130,77 @@ def main() -> None:
     throughput_sps_max = N_CONCURRENT / min(trial_walls)
     throughput_sps_min = N_CONCURRENT / max(trial_walls)
 
+    # ---- continuous-batching engine cell (PR 6 tentpole) -------------
+    # The SAME co-batched best-of-N workload, but through
+    # BatchingBackend(engine=True): iteration-level slot scheduling over
+    # the paged KV pool instead of the flush-snapshot barrier.  Results
+    # are byte-identical (tests/test_engine.py); the deltas worth
+    # reporting are statements/sec, slot occupancy, and padding
+    # efficiency.  Goal (ROADMAP): >=3x legacy bon throughput
+    # (0.15 -> >=0.45 st/s) at >=15% of v5e bf16 peak.  BENCH_ENGINE=0
+    # skips; BENCH_ENGINE_SLOTS resizes the slot table.
+    engine_extra = {}
+    if os.environ.get("BENCH_ENGINE", "1") != "0":
+        engine_slots = int(
+            os.environ.get("BENCH_ENGINE_SLOTS", str(max(8, N_CONCURRENT))))
+
+        def bon_engine(seed0: int):
+            batching = BatchingBackend(
+                backend, engine=True,
+                engine_options={"slots": engine_slots},
+            )
+            try:
+                def worker(i: int) -> str:
+                    with batching.session():
+                        return one_bon(seed0 + i, batching)
+
+                start = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=N_CONCURRENT) as pool:
+                    statements = list(pool.map(worker, range(N_CONCURRENT)))
+                elapsed = time.perf_counter() - start
+                assert all(isinstance(s, str) for s in statements)
+                stats = batching.engine.stats()
+            finally:
+                batching.close()
+            return elapsed, stats
+
+        # Per-trial compile warmup is reported, not hidden: the engine's
+        # paged programs compile once per slot-table shape, and that wall
+        # belongs in the record even though steady-state trials skip it.
+        warmup_start = time.perf_counter()
+        bon_engine(9000)
+        engine_warmup_wall_s = time.perf_counter() - warmup_start
+        engine_before = get_registry().snapshot()
+        engine_trials = []
+        engine_stats = {}
+        for t in range(N_TRIALS):
+            wall, engine_stats = bon_engine(200 + 1000 * t)
+            engine_trials.append(wall)
+        engine_delta = diff_snapshots(engine_before, get_registry().snapshot())
+        engine_wall = statistics.median(engine_trials)
+        engine_sps = N_CONCURRENT / engine_wall
+        engine_pad = padding_efficiency(engine_delta)
+        engine_extra = {
+            "engine_statements_per_sec": round(engine_sps, 4),
+            "engine_trial_walls_s": [round(w, 2) for w in engine_trials],
+            "warmup_wall_s": round(engine_warmup_wall_s, 2),
+            "engine_slots": engine_slots,
+            "engine_slot_occupancy_mean": round(
+                engine_stats.get("slot_occupancy_mean", 0.0), 4),
+            "engine_kv_pages": engine_stats.get("kv_pages"),
+            "engine_kv_pages_high_water": engine_stats.get(
+                "kv_pages_high_water"),
+            "engine_padding_efficiency": (
+                round(engine_pad, 4) if engine_pad is not None else None),
+            "engine_bucket_recompiles_timed_window": bucket_recompiles(
+                engine_delta),
+            "engine_vs_legacy_throughput": round(
+                engine_sps / throughput_sps, 2),
+            "engine_goal": ">=3x legacy bon throughput (0.15 -> >=0.45 "
+                           "st/s) and throughput_pct_of_v5e_bf16_peak "
+                           ">= 15",
+        }
+
     # ---- latency regime: one statement at a time ---------------------
     one_bon(7, backend)  # warmup (narrow single-cell shapes)
     start = time.perf_counter()
@@ -442,6 +513,7 @@ def main() -> None:
                     "finite_lookahead_vs_baseline": round(
                         lookahead_sps / BASELINE_LOOKAHEAD_STATEMENTS_PER_SEC, 2
                     ),
+                    **engine_extra,
                     **mcts_extra,
                     **serve_extra,
                     **chaos_extra,

@@ -84,6 +84,8 @@ class BatchingBackend:
         flush_ms: float = 10.0,
         expected_sessions: int = 1,
         registry: Optional[Registry] = None,
+        engine: bool = False,
+        engine_options: Optional[Dict[str, Any]] = None,
     ):
         self.inner = inner
         self.flush_s = flush_ms / 1000.0
@@ -169,6 +171,27 @@ class BatchingBackend:
         self.batch_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
         #: Per-thread session cancellation probe (set by ``session()``).
         self._tls = threading.local()
+        #: Continuous-batching engine (backends/engine.py): when enabled,
+        #: every protocol call routes straight into the engine's iteration
+        #: loop and the whole flush-snapshot path above is UNREACHABLE —
+        #: no quiescence windows, so ``flush_reason="timeout"`` can never
+        #: be emitted and ``batching_spurious_wakeups_total`` stays pinned
+        #: at 0 (there are no parked flush waiters to wake).  The legacy
+        #: path stays the constructor default for one release.
+        self.engine = None
+        if engine:
+            from consensus_tpu.backends.engine import DecodeEngine
+
+            self.engine = DecodeEngine(
+                inner,
+                registry=reg,
+                cancelled_counter=self._cancelled_requests,
+                **dict(engine_options or {}),
+            )
+            # Serve stats read ``batch_counts`` for device-batch totals;
+            # alias it to the engine's dispatch counters so the surface
+            # keeps one meaning across both paths.
+            self.batch_counts = self.engine.dispatch_counts
 
     @property
     def deterministic_greedy(self) -> bool:
@@ -193,7 +216,18 @@ class BatchingBackend:
             raise FusedSessionUnavailable(
                 f"inner backend {self.inner.name!r} has no fused sessions"
             )
-        return maker(spec)
+        session = maker(spec)
+        if self.engine is not None:
+            # Fused sessions dispatch their own programs, but their slot
+            # footprint still counts as engine pressure (/healthz).
+            session = self.engine.track_session(session, spec)
+        return session
+
+    def close(self) -> None:
+        """Stop the decode engine's iteration loop (no-op on the legacy
+        path, which holds no threads of its own)."""
+        if self.engine is not None:
+            self.engine.close()
 
     def _notify(self, kinds) -> None:
         """Wake the given kinds' waiters.  Caller holds ``_lock`` (every
@@ -277,6 +311,14 @@ class BatchingBackend:
     def _call(self, kind: str, requests: List[Any], fn: Callable) -> Any:
         if not requests:
             return fn(requests)
+        if self.engine is not None:
+            # Engine path: the iteration loop replaces both flush triggers
+            # (all-blocked snapshot AND the quiescence timeout), so none of
+            # the flush-reason/window accounting below runs — see _flush's
+            # guard.
+            return self.engine.submit(
+                kind, requests, probe=getattr(self._tls, "cancelled", None)
+            )
         entry = _Pending(
             requests, cancelled=getattr(self._tls, "cancelled", None)
         )
@@ -340,6 +382,11 @@ class BatchingBackend:
         cells riding full-width device batches.  ``_flushing`` keeps the
         flush single-file (one chip; results must map back to their
         waiters)."""
+        if self.engine is not None:  # pragma: no cover - _call routes away
+            raise AssertionError(
+                "flush-snapshot path reached with the decode engine active; "
+                'flush_reason="timeout" must never be emitted in engine mode'
+            )
         self._flushing = True
         snapshot: Dict[str, List[_Pending]] = {k: [] for k in self._queues}
         dropped_kinds = set()

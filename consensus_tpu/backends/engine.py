@@ -1,0 +1,647 @@
+"""Continuous-batching decode engine: always-on iteration loop over a slot
+table backed by a paged KV cache.
+
+The legacy :class:`~consensus_tpu.backends.batching.BatchingBackend` model
+is flush-snapshot: worker calls queue until EVERY active session blocks (or
+a quiescence window expires), then one merged batch dispatches and the
+cycle restarts.  That barrier is the dominant throughput loss BENCH_r05's
+``mfu_accounting`` names — rows pad to the widest bucket, and the device
+idles between flushes while stragglers finish host work.
+
+This engine replaces the barrier with ITERATION-LEVEL batching (Orca, Yu
+et al., OSDI '22): a persistent loop over a fixed table of ``n_slots``
+request slots.  Each iteration
+
+1. consults cancellation probes — queued work is dropped before any pages
+   are spent, resident rows are EVICTED and their pages freed;
+2. admits queued generate rows into free slots under a conservative page
+   reservation (prompt + max_tokens pages must fit the pool, so a resident
+   row can always finish — no mid-decode preemption);
+3. advances chunked PREFILL: each mid-prefill slot ingests one
+   ``prefill_chunk``-token chunk of its prompt, allocating pages as the
+   chunk crosses page boundaries — long prompts interleave with decode
+   instead of stalling it;
+4. dispatches the DECODE cohort: all prefill-complete slots run as one
+   batch on the inner backend, then retire, freeing their pages — new
+   arrivals admitted meanwhile join the next iteration (requests join and
+   leave at iteration granularity; there is no full-batch flush barrier
+   and no timeout reason);
+5. batches every queued score / next_token / embed call into one inner
+   call per kind.
+
+Correctness: per-request PRNG keys (backends/tpu.py) and (prompt,
+seed)-keyed hashing (backends/fake.py) make every result independent of
+batch composition, so engine cohorts are byte-identical to legacy flushes
+and to solo execution — pinned for all seven methods in
+tests/test_engine.py.
+
+KV residency is tracked in PAGES (ops/kv_pages.py): a slot's stream maps
+to a block table over one fixed pool, so ragged-length slots coexist
+without bucket padding.  On the device side the matching fixed-shape slot
+programs are ``models/stepper.paged_prefill_chunk`` /
+``paged_decode_step`` over ``ops/decode_attention.paged_attention`` —
+compiled ONCE per slot-table shape, with slot lengths entering as data
+only.  The engine delegates token generation itself to the inner backend
+(that is what keeps the seven methods byte-identical across engine
+on/off), while the pool/block-table accounting here is exactly the
+residency contract those programs consume.
+
+A request that could NEVER fit the pool (prompt + max_tokens pages >
+pool) is rejected gracefully with the serving tier's
+``SchedulerRejected`` (lazy import — backends must not import serve at
+module load).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from consensus_tpu.backends.base import (
+    PartialBatchError,
+    RequestCancelled,
+)
+from consensus_tpu.obs.metrics import (
+    DEFAULT_COUNT_BUCKETS,
+    Registry,
+    get_registry,
+)
+from consensus_tpu.ops.kv_pages import BlockTable, PagePool
+
+#: Engine defaults.  ``NUM_PAGES``/``PAGE_SIZE`` give a 16k-token pool —
+#: roomy for CPU/fake runs; real TPU runs size the pool from the backend's
+#: HBM session budget via ``suggest_kv_page_pool``.
+DEFAULT_SLOTS = 8
+DEFAULT_PAGE_SIZE = 16
+DEFAULT_NUM_PAGES = 1024
+DEFAULT_PREFILL_CHUNK = 128
+
+_PREFILL = "prefill"
+_READY = "ready"
+
+
+class _Item:
+    """One submitted call: ``requests`` fan out to rows (generate) or ride
+    whole (score/next_token/embed)."""
+
+    __slots__ = (
+        "kind", "requests", "probe", "event", "result", "error",
+        "rows_left", "row_results", "row_errors", "failed",
+    )
+
+    def __init__(self, kind: str, requests: List[Any], probe):
+        self.kind = kind
+        self.requests = requests
+        self.probe = probe
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.rows_left = len(requests)
+        self.row_results: Dict[int, Any] = {}
+        self.row_errors: Dict[int, BaseException] = {}
+        #: Set when the whole item is being failed (cancel/reject): rows
+        #: still resident are evicted, rows still queued are dropped.
+        self.failed = False
+
+    def cancelled(self) -> bool:
+        if self.probe is None:
+            return False
+        try:
+            return bool(self.probe())
+        except Exception:
+            # A broken probe must not take down the loop — treat as live.
+            return False
+
+
+class _Row:
+    __slots__ = ("item", "index", "request", "prompt_tokens")
+
+    def __init__(self, item: _Item, index: int, request, prompt_tokens: int):
+        self.item = item
+        self.index = index
+        self.request = request
+        self.prompt_tokens = prompt_tokens
+
+
+class _Slot:
+    __slots__ = ("idx", "row", "table", "prefilled", "state", "reserved")
+
+    def __init__(self, idx: int, row: _Row, reserved: int):
+        self.idx = idx
+        self.row = row
+        self.table = BlockTable(idx)
+        self.prefilled = 0
+        self.state = _PREFILL
+        #: Worst-case pages this row may ever need (prompt + max_tokens) —
+        #: held against the pool so a resident row can always decode to
+        #: completion without preemption.
+        self.reserved = reserved
+
+
+class DecodeEngine:
+    """Iteration-loop scheduler over ``n_slots`` slots and one page pool."""
+
+    def __init__(
+        self,
+        inner,
+        *,
+        slots: int = DEFAULT_SLOTS,
+        page_size: int = DEFAULT_PAGE_SIZE,
+        num_pages: Optional[int] = None,
+        prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
+        min_fill: Optional[int] = None,
+        registry: Optional[Registry] = None,
+        cancelled_counter=None,
+        auto_start: bool = True,
+    ):
+        self.inner = inner
+        self.n_slots = max(1, int(slots))
+        if num_pages is None:
+            suggest = getattr(inner, "suggest_kv_page_pool", None)
+            num_pages = (
+                suggest(page_size) if callable(suggest) else DEFAULT_NUM_PAGES
+            )
+        self.pool = PagePool(int(num_pages), page_size)
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        #: Decode dispatch heuristic: with prefills still in progress, hold
+        #: the cohort until at least this many slots are ready — avoids
+        #: fragmenting into narrow cohorts while prompts trickle in.  Once
+        #: nothing is mid-prefill the cohort dispatches at any width, so
+        #: progress is guaranteed (every iteration advances every prefill
+        #: by a chunk).
+        self.min_fill = (
+            max(1, self.n_slots // 2) if min_fill is None else max(1, min_fill)
+        )
+
+        reg = registry if registry is not None else get_registry()
+        self._m_occupancy = reg.gauge(
+            "engine_slot_occupancy",
+            "Occupied fraction of the decode engine's slot table at the "
+            "latest iteration.",
+        )
+        self._m_tokens_iter = reg.histogram(
+            "engine_tokens_per_iteration",
+            "Generated tokens retired per decode-cohort iteration.",
+            buckets=DEFAULT_COUNT_BUCKETS,
+        )
+        self._m_pages = reg.histogram(
+            "kv_pages_in_use",
+            "KV pages allocated from the engine's fixed page pool, sampled "
+            "at each decode dispatch.",
+            buckets=DEFAULT_COUNT_BUCKETS,
+        )
+        self._m_admitted = reg.counter(
+            "engine_admitted_total",
+            "Generate rows admitted into decode-engine slots.",
+        )
+        self._m_evicted = reg.counter(
+            "engine_evicted_total",
+            "Resident rows evicted before completion (cancellation or "
+            "sibling-row failure); their KV pages return to the pool.",
+        )
+        self._m_prefill_chunks = reg.counter(
+            "engine_prefill_chunks_total",
+            "Prompt chunks ingested by interleaved chunked prefill.",
+        )
+        #: Queued-call cancellations share the batching adapter's counter
+        #: family so PR 1 dashboards keep one cancellation series.
+        self._cancelled_counter = cancelled_counter
+
+        #: Inner-backend dispatches per kind — the adapter aliases its
+        #: ``batch_counts`` to this dict so serve stats keep working.
+        self.dispatch_counts = {
+            "generate": 0, "score": 0, "next_token": 0, "embed": 0,
+        }
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._gen_backlog: List[_Row] = []
+        self._other: Dict[str, List[_Item]] = {
+            "score": [], "next_token": [], "embed": [],
+        }
+        self._slots: List[Optional[_Slot]] = [None] * self.n_slots
+        self._reserved_pages = 0
+        self._stopped = False
+        self.iterations = 0
+        self._occ_sum = 0.0
+        self._occ_iters = 0
+        self._search_sessions = 0
+        self._search_slots = 0
+
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self._thread = threading.Thread(
+                target=self._loop, name="decode-engine", daemon=True
+            )
+            self._thread.start()
+
+    # -- public ------------------------------------------------------------
+
+    def submit(
+        self, kind: str, requests: Sequence[Any], probe: Optional[Callable] = None
+    ):
+        """Enqueue one call and block until the loop retires it."""
+        item = _Item(kind, list(requests), probe)
+        with self._work:
+            if self._stopped:
+                raise RuntimeError("decode engine is closed")
+            if kind == "generate":
+                for i, req in enumerate(item.requests):
+                    self._gen_backlog.append(
+                        _Row(item, i, req, self._count_tokens_for(req))
+                    )
+            else:
+                self._other[kind].append(item)
+            self._work.notify_all()
+        item.event.wait()
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def close(self) -> None:
+        with self._work:
+            self._stopped = True
+            self._work.notify_all()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def track_session(self, session, spec):
+        """Seam for ``open_token_search``: fused sessions bypass the request
+        queue (their steps are already single fused programs), but their
+        slot footprint still belongs on the engine's pressure surface —
+        /healthz shows them next to slot occupancy."""
+        with self._lock:
+            self._search_sessions += 1
+            self._search_slots += spec.n_slots
+        orig_close = session.close
+
+        def close():
+            with self._lock:
+                self._search_sessions -= 1
+                self._search_slots -= spec.n_slots
+            orig_close()
+
+        session.close = close
+        return session
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            occupied = sum(1 for s in self._slots if s is not None)
+            pool = self.pool.stats()
+            return {
+                "slots": self.n_slots,
+                "slots_occupied": occupied,
+                "slot_occupancy": occupied / self.n_slots,
+                "slot_occupancy_mean": (
+                    self._occ_sum / self._occ_iters if self._occ_iters else 0.0
+                ),
+                "iterations": self.iterations,
+                "queue_depth": len(self._gen_backlog)
+                + sum(len(q) for q in self._other.values()),
+                "kv_pages": pool.num_pages,
+                "kv_page_size": pool.page_size,
+                "kv_pages_in_use": pool.pages_in_use,
+                "kv_pages_reserved": self._reserved_pages,
+                "kv_pages_high_water": pool.high_water,
+                "fused_search_sessions": self._search_sessions,
+                "fused_search_slots": self._search_slots,
+            }
+
+    # -- loop --------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._stopped and not self._has_work():
+                    self._work.wait()
+                if self._stopped:
+                    self._fail_all(RuntimeError("decode engine closed"))
+                    return
+            try:
+                self.run_iteration()
+            except Exception as exc:  # pragma: no cover - loop must survive
+                with self._work:
+                    self._fail_all(exc)
+
+    def _has_work(self) -> bool:
+        return (
+            bool(self._gen_backlog)
+            or any(self._other.values())
+            or any(s is not None for s in self._slots)
+        )
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """Stop-path cleanup (lock held): fail every queued/resident item."""
+        for row in self._gen_backlog:
+            self._fail_item(row.item, exc)
+        self._gen_backlog = []
+        for slot in list(self._slots):
+            if slot is not None:
+                self._evict(slot, count=False)
+                self._fail_item(slot.row.item, exc)
+        for queue in self._other.values():
+            for item in queue:
+                self._fail_item(item, exc)
+            queue.clear()
+
+    def run_iteration(self) -> None:
+        """One scheduler iteration.  Public so tests can step the engine
+        deterministically (construct with ``auto_start=False``)."""
+        with self._lock:
+            self._process_cancellations()
+            self._admit()
+            self._advance_prefill()
+            cohort = self._decode_cohort()
+            occupied = sum(1 for s in self._slots if s is not None)
+            occ = occupied / self.n_slots
+            self._m_occupancy.set(occ)
+            if occupied:
+                self._occ_sum += occ
+                self._occ_iters += 1
+            self.iterations += 1
+            others = {
+                kind: queue[:] for kind, queue in self._other.items() if queue
+            }
+            for kind in others:
+                self._other[kind] = []
+
+        # Inner-backend calls run WITHOUT the lock: submitters keep
+        # enqueueing while the device is busy, so the next iteration's
+        # cohort and merged kind-batches widen for free (the same overlap
+        # the legacy flush got from releasing its lock mid-dispatch).
+        if cohort:
+            self._dispatch_decode(cohort)
+        for kind, items in others.items():
+            self._dispatch_other(kind, items)
+
+    # -- iteration phases (lock held) ---------------------------------------
+
+    def _process_cancellations(self) -> None:
+        cancelled_items = set()
+        keep: List[_Row] = []
+        for row in self._gen_backlog:
+            if row.item.failed or row.item in cancelled_items or row.item.cancelled():
+                cancelled_items.add(row.item)
+            else:
+                keep.append(row)
+        self._gen_backlog = keep
+        for slot in list(self._slots):
+            if slot is None:
+                continue
+            item = slot.row.item
+            if item.failed or item in cancelled_items or item.cancelled():
+                cancelled_items.add(item)
+                self._evict(slot)
+        for kind, queue in self._other.items():
+            live: List[_Item] = []
+            for item in queue:
+                if item.cancelled():
+                    if self._cancelled_counter is not None:
+                        self._cancelled_counter.labels(kind).inc()
+                    self._fail_item(
+                        item,
+                        RequestCancelled(
+                            f"session cancelled before its {kind} call ran"
+                        ),
+                    )
+                else:
+                    live.append(item)
+            self._other[kind] = live
+        for item in cancelled_items:
+            if self._cancelled_counter is not None and not item.failed:
+                self._cancelled_counter.labels("generate").inc()
+            self._fail_item(
+                item,
+                RequestCancelled(
+                    "session cancelled; its resident rows were evicted and "
+                    "their KV pages freed"
+                ),
+            )
+
+    def _admit(self) -> None:
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        while free and self._gen_backlog:
+            row = self._gen_backlog[0]
+            if row.item.failed:
+                self._gen_backlog.pop(0)
+                continue
+            needed = self.pool.pages_for_tokens(
+                row.prompt_tokens + int(getattr(row.request, "max_tokens", 0))
+            )
+            if needed > self.pool.num_pages:
+                self._gen_backlog.pop(0)
+                self._reject_oversized(row, needed)
+                continue
+            if self._reserved_pages + needed > self.pool.num_pages:
+                # Fits the pool but not right now — hold FIFO order and
+                # wait for resident rows to retire.
+                break
+            self._gen_backlog.pop(0)
+            slot = _Slot(free.pop(0), row, reserved=needed)
+            self._slots[slot.idx] = slot
+            self._reserved_pages += needed
+            self._m_admitted.inc()
+
+    def _advance_prefill(self) -> None:
+        for slot in self._slots:
+            if slot is None or slot.state != _PREFILL:
+                continue
+            remaining = slot.row.prompt_tokens - slot.prefilled
+            chunk = min(self.prefill_chunk, remaining)
+            if chunk > 0:
+                # Reservation guarantees the pool has room.
+                slot.table.append_tokens(self.pool, chunk)
+                slot.prefilled += chunk
+                self._m_prefill_chunks.inc()
+            if slot.prefilled >= slot.row.prompt_tokens:
+                slot.state = _READY
+
+    def _decode_cohort(self) -> List[_Slot]:
+        ready = [s for s in self._slots if s is not None and s.state == _READY]
+        prefilling = any(
+            s is not None and s.state == _PREFILL for s in self._slots
+        )
+        if not ready or (prefilling and len(ready) < self.min_fill):
+            return []
+        for slot in ready:
+            # Generated-token pages, allocated up front (the reservation
+            # made at admission covers them); retired below with the slot.
+            slot.table.append_tokens(
+                self.pool, int(getattr(slot.row.request, "max_tokens", 0))
+            )
+        self._m_pages.observe(self.pool.in_use)
+        return ready
+
+    # -- dispatch (lock released) -------------------------------------------
+
+    def _dispatch_decode(self, cohort: List[_Slot]) -> None:
+        requests = [slot.row.request for slot in cohort]
+        self.dispatch_counts["generate"] += 1
+        results: Optional[List[Any]] = None
+        row_errors: Dict[int, BaseException] = {}
+        batch_error: Optional[BaseException] = None
+        try:
+            results = self.inner.generate(requests)
+        except PartialBatchError as exc:
+            results = list(exc.results)
+            row_errors = dict(exc.row_errors)
+        except Exception as exc:
+            batch_error = exc
+
+        with self._lock:
+            tokens = 0
+            for i, slot in enumerate(cohort):
+                self._retire(slot)
+                item = slot.row.item
+                if batch_error is not None:
+                    self._fail_item(item, batch_error)
+                elif i in row_errors:
+                    self._record_row(item, slot.row.index, None, row_errors[i])
+                else:
+                    result = results[i]
+                    ids = getattr(result, "token_ids", None) or ()
+                    tokens += len(ids) if ids else self._count_text_tokens(
+                        getattr(result, "text", "") or ""
+                    )
+                    self._record_row(item, slot.row.index, result, None)
+            self._m_tokens_iter.observe(tokens)
+            self._work.notify_all()
+
+    def _dispatch_other(self, kind: str, items: List[_Item]) -> None:
+        fn = {
+            "score": self.inner.score,
+            "next_token": self.inner.next_token_logprobs,
+            "embed": self.inner.embed,
+        }[kind]
+        merged: List[Any] = []
+        for item in items:
+            merged.extend(item.requests)
+        self.dispatch_counts[kind] += 1
+        try:
+            results = fn(merged)
+            cursor = 0
+            for item in items:
+                n = len(item.requests)
+                item.result = list(results[cursor : cursor + n])
+                cursor += n
+                item.event.set()
+        except PartialBatchError as exc:
+            cursor = 0
+            for item in items:
+                n = len(item.requests)
+                slice_errors = {
+                    i - cursor: err
+                    for i, err in exc.row_errors.items()
+                    if cursor <= i < cursor + n
+                }
+                if not slice_errors:
+                    item.result = list(exc.results[cursor : cursor + n])
+                elif len(slice_errors) == n:
+                    item.error = next(iter(slice_errors.values()))
+                else:
+                    item.error = PartialBatchError(
+                        f"{len(slice_errors)}/{n} rows of this session's "
+                        f"{kind} call failed inside an engine iteration",
+                        results=list(exc.results[cursor : cursor + n]),
+                        row_errors=slice_errors,
+                    )
+                cursor += n
+                item.event.set()
+        except Exception as exc:
+            for item in items:
+                item.error = exc
+                item.event.set()
+        with self._lock:
+            self._work.notify_all()
+
+    # -- bookkeeping (lock held) --------------------------------------------
+
+    def _retire(self, slot: _Slot) -> None:
+        slot.table.release(self.pool)
+        self._reserved_pages -= slot.reserved
+        self._slots[slot.idx] = None
+
+    def _evict(self, slot: _Slot, count: bool = True) -> None:
+        self._retire(slot)
+        if count:
+            self._m_evicted.inc()
+
+    def _record_row(
+        self, item: _Item, index: int, result, error: Optional[BaseException]
+    ) -> None:
+        if error is None:
+            item.row_results[index] = result
+        else:
+            item.row_errors[index] = error
+        item.rows_left -= 1
+        if item.rows_left == 0 and not item.failed:
+            self._finalize(item)
+
+    def _finalize(self, item: _Item) -> None:
+        if not item.row_errors:
+            item.result = [
+                item.row_results[i] for i in range(len(item.requests))
+            ]
+        elif len(item.row_errors) == len(item.requests):
+            item.error = next(iter(item.row_errors.values()))
+        else:
+            item.error = PartialBatchError(
+                f"{len(item.row_errors)}/{len(item.requests)} rows of this "
+                "session's generate call failed inside an engine iteration",
+                results=[
+                    item.row_results.get(i) for i in range(len(item.requests))
+                ],
+                row_errors=dict(item.row_errors),
+            )
+        item.failed = item.error is not None
+        item.event.set()
+
+    def _fail_item(self, item: _Item, exc: BaseException) -> None:
+        """Fail a whole item: queued rows are skipped on sight (``failed``),
+        resident siblings get evicted by the cancellation sweep."""
+        if item.failed or item.event.is_set():
+            item.failed = True
+            return
+        item.failed = True
+        item.error = exc
+        item.event.set()
+
+    def _reject_oversized(self, row: _Row, needed: int) -> None:
+        # Lazy import: backends must not import the serving tier at module
+        # load (serve imports batching), but the OOM contract is the
+        # scheduler's typed admission signal.
+        from consensus_tpu.serve.scheduler import SchedulerRejected
+
+        self._fail_item(
+            row.item,
+            SchedulerRejected(
+                "kv_oom",
+                f"request needs {needed} KV pages; the pool holds only "
+                f"{self.pool.num_pages} ({self.pool.page_size} tokens/page) "
+                "— it can never be scheduled",
+            ),
+        )
+
+    # -- token accounting ----------------------------------------------------
+
+    def _count_tokens_for(self, request) -> int:
+        parts = [
+            getattr(request, "system_prompt", None) or "",
+            getattr(request, "user_prompt", "") or "",
+        ]
+        return max(1, self._count_text_tokens(" ".join(p for p in parts if p)))
+
+    def _count_text_tokens(self, text: str) -> int:
+        """Token count for PAGE accounting only — never for numerics.  Uses
+        the inner backend's real tokenizer when it has one; the fake
+        backend's whitespace pseudo-tokenizer otherwise."""
+        tok = getattr(self.inner, "tokenizer", None)
+        if tok is not None and hasattr(tok, "encode"):
+            try:
+                return len(tok.encode(text))
+            except Exception:
+                pass
+        pseudo = getattr(self.inner, "_tokenize", None)
+        if callable(pseudo):
+            return len(pseudo(text))
+        return len(text.split())

@@ -314,4 +314,13 @@ def open_token_search(backend, spec: SearchSpec):
             return maker(spec)
         except FusedSessionUnavailable:
             pass
-    return PrefixTokenSearchSession(backend, spec)
+    session = PrefixTokenSearchSession(backend, spec)
+    # Continuous-batching seam: over an engine-mode batching adapter the
+    # fallback's per-step calls already land in the engine's iteration loop
+    # as (prefill, decode-step, score) slot operations; registering the
+    # session here additionally surfaces its slot footprint in the engine's
+    # pressure stats (/healthz), same as fused sessions.
+    engine = getattr(backend, "engine", None)
+    if engine is not None and hasattr(engine, "track_session"):
+        session = engine.track_session(session, spec)
+    return session

@@ -239,11 +239,15 @@ class TPUBackend:
         # only the int8+scale leaves across.
         import contextlib
 
-        host = (
-            jax.default_device(jax.local_devices(backend="cpu")[0])
-            if want_int8
-            else contextlib.nullcontext()
-        )
+        def host():
+            # Fresh context per use: jax.default_device returns a
+            # single-entry context manager, and the int8 path enters once
+            # for init/load and again for the quantize pass.
+            return (
+                jax.default_device(jax.local_devices(backend="cpu")[0])
+                if want_int8
+                else contextlib.nullcontext()
+            )
         if params is not None:
             self.params = params
         elif checkpoint and (pathlib.Path(checkpoint) / "ingest.json").exists():
@@ -299,7 +303,7 @@ class TPUBackend:
         elif checkpoint:
             from consensus_tpu.models.loader import load_params
 
-            with host:
+            with host():
                 self.params = load_params(checkpoint, self.config, jax_dtype)
         else:
             logger.warning(
@@ -307,7 +311,7 @@ class TPUBackend:
                 "Statements will be noise; timings/shapes are real.",
                 self.config.name,
             )
-            with host:
+            with host():
                 self.params = init_params(
                     self.config, jax.random.PRNGKey(base_seed), jax_dtype
                 )
@@ -321,7 +325,7 @@ class TPUBackend:
 
             if not is_quantized(self.params):  # shared params may already be
                 if want_int8:  # host tree: quantize on host, then transfer
-                    with host:
+                    with host():
                         # jit on the host device so XLA fuses the f32 casts
                         # instead of materializing eager 2x-size temporaries;
                         # donation frees each full-precision leaf as it is
@@ -395,6 +399,25 @@ class TPUBackend:
         self._session_budget = _SessionBudget(budget)
 
     # -- helpers -------------------------------------------------------------
+
+    def suggest_kv_page_pool(self, page_size: int = 16) -> int:
+        """Size the decode engine's KV page pool from the session HBM
+        budget (backends/engine.py asks at construction).  One page holds
+        ``page_size`` tokens of per-layer K+V; ``kv_quant`` halves the
+        bytes (int8 + per-token scale ≈ half of bf16).  Half the session
+        budget goes to pages — the rest stays for fused search sessions,
+        which reserve through ``_SessionBudget`` as before."""
+        c = self.config
+        kv_itemsize = (
+            1.25
+            if self.kv_quant
+            else jnp.dtype(self.params["embed"].dtype).itemsize
+        )
+        bytes_per_token = int(
+            2 * c.n_layers * c.n_kv_heads * c.head_dim * kv_itemsize
+        ) // self._shard_count or 1
+        page_bytes = bytes_per_token * page_size
+        return max(64, (self._session_budget.cap // 2) // page_bytes)
 
     def _sliced(self, requests, fn, limit: Optional[int] = None):
         """Run ``fn`` over ``limit``-sized slices (default max_batch_rows)

@@ -370,10 +370,16 @@ class Experiment:
 
                     from consensus_tpu.backends.batching import BatchingBackend
 
+                    # ``engine: true`` routes the workers' calls through the
+                    # continuous-batching decode engine instead of the
+                    # legacy flush-snapshot path (results byte-identical;
+                    # tests/test_engine.py pins all seven methods).
                     batching = BatchingBackend(
                         self.backend,
                         flush_ms=float(self.config.get("batch_flush_ms", 10.0)),
                         expected_sessions=min(max_workers, len(pending)),
+                        engine=bool(self.config.get("engine", False)),
+                        engine_options=self.config.get("engine_options"),
                     )
 
                     def worker(item):
@@ -388,9 +394,12 @@ class Experiment:
                             )
                         return index, finish(index, run, row)
 
-                    with ThreadPoolExecutor(max_workers=max_workers) as pool:
-                        for index, row in pool.map(worker, pending):
-                            rows_by_index[index] = row
+                    try:
+                        with ThreadPoolExecutor(max_workers=max_workers) as pool:
+                            for index, row in pool.map(worker, pending):
+                                rows_by_index[index] = row
+                    finally:
+                        batching.close()
                     self.last_batch_counts = dict(batching.batch_counts)
                     logger.info(
                         "Device batches issued: %s (%d runs, %d workers)",

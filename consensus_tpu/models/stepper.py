@@ -44,6 +44,7 @@ import jax.numpy as jnp
 
 from consensus_tpu.models.config import ModelConfig
 from consensus_tpu.models.generate import left_pad_positions
+from consensus_tpu.models.quant import matmul, take_rows
 from consensus_tpu.models.transformer import (
     KVCache,
     forward,
@@ -51,7 +52,11 @@ from consensus_tpu.models.transformer import (
     forward_trunk_tail,
     make_cache,
     project_logits,
+    rms_norm,
+    apply_rope,
+    _softcap,
 )
+from consensus_tpu.ops.decode_attention import paged_attention
 
 
 class SearchState(NamedTuple):
@@ -513,3 +518,177 @@ def rollout_scored_many(
     )
     _, out_rows = jax.lax.scan(step, init, jnp.arange(depth))
     return jnp.moveaxis(out_rows, 0, 1)  # (P, depth, 2 + A)
+
+
+# ---------------------------------------------------------------------------
+# Paged slot programs (continuous-batching engine)
+# ---------------------------------------------------------------------------
+#
+# The decode engine (backends/engine.py) holds every resident request's KV
+# in fixed-size pages (ops/kv_pages.py); the two programs below are the
+# engine's device-side primitives, both compiled to ONE fixed shape per
+# (n_slots, chunk, max_blocks, num_pages) — a slot's ACTUAL length only
+# enters as data (block tables, lengths, write cursors), never as a shape,
+# so ragged-length serving load causes zero recompiles.
+#
+# Page arrays carry one extra SINK page at index num_pages: inactive slots
+# and invalid chunk columns write their K/V there (scatter needs somewhere
+# to land under fixed shapes), and nothing ever reads it — block tables
+# only name pool pages 0..num_pages-1.
+
+
+class PagedSlotState(NamedTuple):
+    """Device page pool: K/V for every resident slot, owned by block tables
+    host-side.  Shape (L, num_pages + 1, page_size, KV, hd); the final page
+    is the write sink."""
+
+    k_pages: jax.Array
+    v_pages: jax.Array
+
+
+def make_page_state(
+    config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.float32
+) -> PagedSlotState:
+    c = config
+    shape = (c.n_layers, num_pages + 1, page_size, c.n_kv_heads, c.head_dim)
+    return PagedSlotState(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def _paged_forward(
+    params,
+    c: ModelConfig,
+    tokens: jax.Array,  # (B, S) int32
+    positions: jax.Array,  # (B, S) int32
+    state: PagedSlotState,
+    block_tables: jax.Array,  # (B, max_blocks) int32, -1 padded
+    lengths: jax.Array,  # (B,) int32 — INCLUDING this call's tokens
+    write_pages: jax.Array,  # (B, S) int32 — sink for invalid columns
+    write_offsets: jax.Array,  # (B, S) int32
+):
+    """Shared body of chunked prefill and the decode step: write this
+    call's K/V into the pages the cursors name, then attend every query
+    through its slot's block table.  Returns (hidden (B, S, D), state)."""
+    b, s = tokens.shape
+    h, kv, hd = c.n_heads, c.n_kv_heads, c.head_dim
+    x = take_rows(params["embed"], tokens)
+    if c.scale_embeddings:
+        x = x * jnp.asarray(c.d_model**0.5, x.dtype)
+    local_flags = jnp.asarray(c.local_flags)
+
+    def layer_step(x, scanned):
+        lp, kp_l, vp_l, is_local = scanned
+        attn_in = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rmsnorm_style)
+        q = matmul(attn_in, lp["wq"]).reshape(b, s, h, hd)
+        k = matmul(attn_in, lp["wk"]).reshape(b, s, kv, hd)
+        v = matmul(attn_in, lp["wv"]).reshape(b, s, kv, hd)
+        q = apply_rope(q, positions, c.rope_theta, c.rope_scaling)
+        k = apply_rope(k, positions, c.rope_theta, c.rope_scaling)
+
+        # Scatter the fresh K/V into their pages.  Cursor pairs are unique
+        # across rows (slots own disjoint pages) except the sink, which is
+        # never read, so duplicate-index order doesn't matter.
+        kp_l = kp_l.at[write_pages, write_offsets].set(k)
+        vp_l = vp_l.at[write_pages, write_offsets].set(v)
+
+        def attend(window):
+            return paged_attention(
+                q, kp_l, vp_l, block_tables, lengths, positions,
+                scale=c.q_scale, softcap=c.attn_softcap, window=window,
+            )
+
+        if c.sliding_window is None:
+            attn = attend(None)
+        else:
+            attn = jax.lax.cond(
+                is_local,
+                lambda _: attend(c.sliding_window),
+                lambda _: attend(None),
+                None,
+            )
+        attn = matmul(attn.reshape(b, s, h * hd), lp["wo"])
+        if c.use_post_norms:
+            attn = rms_norm(attn, lp["post_attn_norm"], c.rms_eps, c.rmsnorm_style)
+        x = x + attn
+
+        ffn_in = rms_norm(x, lp["ffn_norm"], c.rms_eps, c.rmsnorm_style)
+        gate = matmul(ffn_in, lp["w_gate"])
+        if c.activation == "geglu":
+            gate = jax.nn.gelu(gate, approximate=True)
+        else:
+            gate = jax.nn.silu(gate)
+        ffn = matmul(gate * matmul(ffn_in, lp["w_up"]), lp["w_down"])
+        if c.use_post_norms:
+            ffn = rms_norm(ffn, lp["post_ffn_norm"], c.rms_eps, c.rmsnorm_style)
+        x = x + ffn
+        return x, (kp_l, vp_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], state.k_pages, state.v_pages, local_flags)
+    )
+    x = rms_norm(x, params["final_norm"], c.rms_eps, c.rmsnorm_style)
+    return x, PagedSlotState(new_k, new_v)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config",), donate_argnums=(4,)
+)
+def paged_prefill_chunk(
+    params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (B, C) int32 — one prompt chunk per slot
+    chunk_valid: jax.Array,  # (B, C) bool — real tokens of this chunk
+    state: PagedSlotState,
+    block_tables: jax.Array,  # (B, max_blocks)
+    lengths: jax.Array,  # (B,) int32 — stream length AFTER this chunk
+    write_pages: jax.Array,  # (B, C)
+    write_offsets: jax.Array,  # (B, C)
+) -> Tuple[jax.Array, PagedSlotState]:
+    """Ingest one prompt chunk per slot into the page pool.
+
+    Chunk token j of slot b sits at stream position lengths[b] - valid_count
+    + j, attending everything the slot already holds plus the chunk's own
+    earlier tokens — so a prompt prefills in ceil(W / C) fixed-shape calls
+    interleaved between decode iterations instead of one W-bucketed
+    program.  Returns the final-norm hidden of each slot's LAST valid chunk
+    position (B, D) — callers project logits only when the prompt is
+    complete — and the updated page state.
+    """
+    b, chunk = tokens.shape
+    n_valid = jnp.sum(chunk_valid.astype(jnp.int32), axis=1)  # (B,)
+    start = lengths - n_valid
+    positions = start[:, None] + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+    hidden, state = _paged_forward(
+        params, config, tokens, positions, state,
+        block_tables, lengths, write_pages, write_offsets,
+    )
+    last = jnp.maximum(n_valid - 1, 0)
+    hidden_last = jnp.take_along_axis(
+        hidden, last[:, None, None], axis=1
+    )[:, 0, :]
+    return hidden_last, state
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config",), donate_argnums=(3,)
+)
+def paged_decode_step(
+    params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (B,) int32 — one token per slot
+    state: PagedSlotState,
+    block_tables: jax.Array,  # (B, max_blocks)
+    lengths: jax.Array,  # (B,) int32 — stream length INCLUDING this token
+    write_pages: jax.Array,  # (B,) int32 — sink page for inactive slots
+    write_offsets: jax.Array,  # (B,) int32
+) -> Tuple[jax.Array, PagedSlotState]:
+    """One decode iteration for the whole slot table: every active slot
+    advances one position, reading K/V through its own block table.  One
+    compiled shape regardless of slot lengths.  Returns (logits (B, V)
+    f32, updated page state)."""
+    positions = (lengths - 1)[:, None]
+    hidden, state = _paged_forward(
+        params, config, tokens[:, None], positions, state,
+        block_tables, lengths, write_pages[:, None], write_offsets[:, None],
+    )
+    logits = project_logits(params, config, hidden[:, 0, :])
+    return logits, state

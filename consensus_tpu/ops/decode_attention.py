@@ -271,3 +271,67 @@ def decode_attention(
         .reshape(rows, h, hd)
     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Paged attention: gather K/V through per-slot block tables
+# ---------------------------------------------------------------------------
+
+
+def paged_attention(
+    q: jax.Array,  # (B, S, H, hd) — S query positions per slot (decode: S=1)
+    k_pages: jax.Array,  # (num_pages[+sink], page_size, KV, hd)
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32, -1 padded
+    lengths: jax.Array,  # (B,) int32 — valid tokens in the slot's stream
+    q_positions: jax.Array,  # (B, S) int32 — query RoPE positions
+    scale: float,
+    softcap: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jax.Array:
+    """GQA attention over a PAGED KV cache (Kwon et al., SOSP '23 layout).
+
+    Each slot's K/V live in the fixed page pool at the pages its block
+    table names; the gather materializes a (B, max_blocks·page_size, ...)
+    view, so ragged-length slots coexist in ONE fixed-shape program — the
+    compiled shape is (B, S, max_blocks) and never depends on any slot's
+    actual length.  A slot's token t sits at page ``table[t // page_size]``
+    offset ``t % page_size`` with RoPE position t (streams are contiguous
+    from 0), so causality is plain position arithmetic.
+
+    Padding rows of the block table (-1) gather page 0 but are masked by
+    ``lengths``; rows past a slot's length inside its last page are masked
+    the same way.  Pure jnp on purpose: the engine's slot programs must run
+    (and be pinned) under JAX_PLATFORMS=cpu; the pallas fusion of this
+    gather is a later optimization behind the same signature.
+
+    Returns (B, S, H, hd) in q's dtype.
+    """
+    b, s, h, hd = q.shape
+    page_size, kv = k_pages.shape[1], k_pages.shape[2]
+    max_blocks = block_tables.shape[1]
+    reps = h // kv
+    t_len = max_blocks * page_size
+
+    safe_tables = jnp.maximum(block_tables, 0)
+    keys = k_pages[safe_tables].reshape(b, t_len, kv, hd)
+    values = v_pages[safe_tables].reshape(b, t_len, kv, hd)
+
+    kpos = jnp.arange(t_len, dtype=jnp.int32)[None, :]  # (1, T)
+    k_valid = kpos < lengths[:, None]  # (B, T)
+    causal = kpos[:, None, :] <= q_positions[:, :, None]  # (B, S, T)
+    mask = causal & k_valid[:, None, :]
+    if window is not None:
+        mask = mask & (q_positions[:, :, None] - kpos[:, None, :] < window)
+
+    # Grouped-query einsum without materializing repeated KV (mirrors the
+    # transformer.forward einsum path).
+    qg = q.reshape(b, s, kv, reps, hd)
+    logits = jnp.einsum("bsgrd,btgd->bgrst", qg, keys).astype(jnp.float32)
+    logits = logits * scale
+    if softcap is not None:
+        logits = softcap * jnp.tanh(logits / softcap)
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    attn = jnp.einsum("bgrst,btgd->bsgrd", weights, values)
+    return attn.reshape(b, s, h, hd)

@@ -1,0 +1,171 @@
+"""Paged KV cache bookkeeping: a free-list page allocator + per-slot block
+tables (PagedAttention-style block management, Kwon et al., SOSP '23).
+
+The continuous-batching engine (``backends/engine.py``) keeps every
+resident request's KV in fixed-size PAGES drawn from one fixed pool sized
+at startup — instead of one contiguous, bucket-padded cache per batch.  A
+slot's logical token stream maps to a BLOCK TABLE (ordered page list);
+ragged-length slots coexist without padding each other, and a finished or
+cancelled slot returns its pages to the free list immediately.
+
+This module is the HOST side: allocation, block tables, and the no-aliasing
+invariant (a page belongs to at most one owner at a time — double frees and
+foreign frees raise).  The DEVICE side — gathering K/V through a block
+table inside attention — lives in ``ops/decode_attention.paged_attention``
+and the slot programs in ``models/stepper.py``.
+
+Thread safety: the engine loop is single-threaded, but ``stats()`` is read
+from serving threads (/healthz), so the pool takes a lock around every
+mutation and snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+class PagePoolExhausted(RuntimeError):
+    """An allocation did not fit the pool's free list.  The engine maps this
+    to admission-level backpressure (``SchedulerRejected``) — it must never
+    escape to a waiter as a bare RuntimeError."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolStats:
+    num_pages: int
+    page_size: int
+    pages_in_use: int
+    pages_free: int
+    high_water: int
+
+
+class PagePool:
+    """Fixed pool of KV pages with a LIFO free list.
+
+    All-or-nothing allocation: ``alloc(n)`` either returns ``n`` distinct
+    page ids or raises :class:`PagePoolExhausted` leaving the pool
+    untouched.  LIFO reuse keeps the working set of page ids dense, which
+    keeps device block tables cache-friendly and makes aliasing bugs (a
+    freed page handed to two owners) surface immediately in tests.
+    """
+
+    def __init__(self, num_pages: int, page_size: int = 16):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError(
+                f"pool needs positive dimensions, got {num_pages=} {page_size=}"
+            )
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._owner: Dict[int, object] = {}
+        self._high_water = 0
+
+    # -- allocation --------------------------------------------------------
+
+    def pages_for_tokens(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` KV entries (ceil)."""
+        return -(-max(0, int(n_tokens)) // self.page_size)
+
+    def alloc(self, n: int, owner: object = None) -> List[int]:
+        with self._lock:
+            if n > len(self._free):
+                raise PagePoolExhausted(
+                    f"need {n} pages, {len(self._free)} free of {self.num_pages}"
+                )
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._owner[p] = owner
+            self._high_water = max(self._high_water, len(self._owner))
+            return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p not in self._owner:
+                    raise ValueError(
+                        f"page {p} is not allocated (double free or foreign page)"
+                    )
+                del self._owner[p]
+                self._free.append(p)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return len(self._owner)
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def stats(self) -> PoolStats:
+        with self._lock:
+            return PoolStats(
+                num_pages=self.num_pages,
+                page_size=self.page_size,
+                pages_in_use=len(self._owner),
+                pages_free=len(self._free),
+                high_water=self._high_water,
+            )
+
+
+class BlockTable:
+    """One slot's ordered page list + logical token length.
+
+    ``append_tokens`` grows the table to cover ``num_tokens + n`` tokens,
+    allocating pages only when the current last page is full — so a slot
+    ingesting a prompt chunk-by-chunk touches the allocator once per
+    page boundary, not once per token.
+    """
+
+    def __init__(self, slot: int):
+        self.slot = slot
+        self.pages: List[int] = []
+        self.num_tokens = 0
+
+    def append_tokens(self, pool: PagePool, n: int) -> List[int]:
+        """Extend the logical stream by ``n`` tokens; returns newly
+        allocated page ids (all-or-nothing — on PagePoolExhausted the table
+        is unchanged)."""
+        target = self.num_tokens + int(n)
+        need = pool.pages_for_tokens(target) - len(self.pages)
+        fresh: List[int] = []
+        if need > 0:
+            fresh = pool.alloc(need, owner=self)
+            self.pages.extend(fresh)
+        self.num_tokens = target
+        return fresh
+
+    def release(self, pool: PagePool) -> None:
+        if self.pages:
+            pool.free(self.pages)
+        self.pages = []
+        self.num_tokens = 0
+
+    def write_cursor(self, pool: PagePool) -> tuple:
+        """(page_id, offset) where the NEXT token's KV lands.  Valid only
+        after ``append_tokens`` reserved room for it."""
+        if not self.pages:
+            raise ValueError("empty block table has no write cursor")
+        last = self.num_tokens - 1
+        return self.pages[last // pool.page_size], last % pool.page_size
+
+    def as_array(self, max_blocks: int) -> np.ndarray:
+        """Fixed-shape device view: (max_blocks,) int32, -1 padded — the
+        shape every compiled slot program sees regardless of this slot's
+        actual length (no per-length recompiles)."""
+        if len(self.pages) > max_blocks:
+            raise ValueError(
+                f"slot {self.slot} holds {len(self.pages)} pages > "
+                f"max_blocks={max_blocks}"
+            )
+        out = np.full((max_blocks,), -1, np.int32)
+        out[: len(self.pages)] = self.pages
+        return out

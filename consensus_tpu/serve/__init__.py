@@ -47,6 +47,8 @@ def create_server(
     brownout: bool = False,
     target_p95_ms=None,
     anytime_margin_s: float = 0.2,
+    engine: bool = False,
+    engine_options=None,
 ) -> ConsensusServer:
     """Wire backend → service → scheduler → HTTP server (not yet started).
 
@@ -60,14 +62,19 @@ def create_server(
     pressure, newly dispatched requests run at a scaled-down search budget
     (responses tagged ``degraded``) instead of queueing into 504s.
     ``target_p95_ms`` adds a latency-SLO term to the pressure signal.
+    ``engine=True`` swaps the scheduler's merge layer from the legacy
+    flush-snapshot BatchingBackend to the continuous-batching decode
+    engine (``--engine`` on the CLI): same byte-identical results, no
+    flush barrier, and /healthz gains slot-table + KV-page-pool pressure.
+
     Defaults OFF so a quiet server's responses stay byte-identical to
     offline Experiment runs (pinned in tests/test_serve.py)."""
     from consensus_tpu.backends import get_backend, wrap_backend
 
-    engine = get_backend(backend, **(backend_options or {}))
+    inner = get_backend(backend, **(backend_options or {}))
     if fault_plan is not None or supervise:
-        engine = wrap_backend(
-            engine, fault_plan=fault_plan, supervise=supervise,
+        inner = wrap_backend(
+            inner, fault_plan=fault_plan, supervise=supervise,
             registry=registry,
         )
     controller = None
@@ -78,10 +85,10 @@ def create_server(
             ),
             registry=registry,
         )
-    service = ConsensusService(engine, generation_model=generation_model)
+    service = ConsensusService(inner, generation_model=generation_model)
     scheduler = RequestScheduler(
         handler=service.run,
-        backend=engine,
+        backend=inner,
         max_queue_depth=max_queue_depth,
         max_inflight=max_inflight,
         default_timeout_s=default_timeout_s,
@@ -90,5 +97,7 @@ def create_server(
         registry=registry,
         brownout=controller,
         anytime_margin_s=anytime_margin_s,
+        engine=engine,
+        engine_options=engine_options,
     )
     return ConsensusServer(scheduler, host=host, port=port, registry=registry)

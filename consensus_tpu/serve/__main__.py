@@ -60,6 +60,14 @@ def main(argv=None) -> int:
     parser.add_argument("--target-p95-ms", type=float, default=None,
                         help="latency SLO fed into the brownout pressure "
                              "signal (implies --brownout)")
+    parser.add_argument("--engine", action="store_true",
+                        help="serve through the continuous-batching decode "
+                             "engine (slot table + paged KV cache) instead "
+                             "of the legacy flush-snapshot merge; results "
+                             "are byte-identical")
+    parser.add_argument("--engine-options", default="{}",
+                        help="JSON object of DecodeEngine kwargs (e.g. "
+                             '\'{"slots": 16, "page_size": 16}\')')
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -83,6 +91,8 @@ def main(argv=None) -> int:
         generation_model=args.generation_model,
         brownout=args.brownout or args.target_p95_ms is not None,
         target_p95_ms=args.target_p95_ms,
+        engine=args.engine,
+        engine_options=json.loads(args.engine_options),
     )
     stop = threading.Event()
 
@@ -102,6 +112,7 @@ def main(argv=None) -> int:
         "max_queue_depth": args.max_queue_depth,
         "max_inflight": args.max_inflight,
         "brownout": args.brownout or args.target_p95_ms is not None,
+        "engine": args.engine,
     }))
     try:
         stop.wait()

@@ -157,6 +157,8 @@ class RequestScheduler:
         registry: Optional[Registry] = None,
         brownout: Optional[BrownoutController] = None,
         anytime_margin_s: float = 0.2,
+        engine: bool = False,
+        engine_options: Optional[Dict[str, Any]] = None,
     ):
         if max_queue_depth < 1 or max_inflight < 1:
             raise ValueError("max_queue_depth and max_inflight must be >= 1")
@@ -197,6 +199,11 @@ class RequestScheduler:
             flush_ms=flush_ms,
             expected_sessions=self.max_inflight,
             registry=reg,
+            # ``engine=True`` swaps the flush-snapshot merge for the
+            # continuous-batching decode engine — same byte-identical
+            # results, no flush barrier; slot/page pressure joins stats().
+            engine=engine,
+            engine_options=engine_options,
         )
         self._m_queue_depth = reg.gauge(
             "serve_queue_depth", "Requests waiting in the admission queue.")
@@ -285,6 +292,9 @@ class RequestScheduler:
             if deadline is not None:
                 join_for = max(0.0, deadline - time.monotonic())
             thread.join(timeout=join_for)
+        # Engine mode holds a scheduler thread of its own; release it once
+        # no worker can issue further backend calls.
+        self.batching.close()
 
     # -- admission ---------------------------------------------------------
 
@@ -386,6 +396,10 @@ class RequestScheduler:
                 "workers_alive": sum(t.is_alive() for t in self._workers),
                 "device_batches": dict(self.batching.batch_counts),
             }
+        if self.batching.engine is not None:
+            # Slot/page pressure next to queue depth: /healthz shows how
+            # full the decode slot table and KV page pool are.
+            stats["engine"] = self.batching.engine.stats()
         if self.circuit_breaker is not None:
             stats["circuit_breaker"] = self.circuit_breaker.snapshot()
         if self.brownout is not None:

@@ -1,0 +1,557 @@
+"""Continuous-batching decode engine (backends/engine.py) + paged KV cache
+(ops/kv_pages.py).
+
+The PR 6 contract, pinned here:
+
+* byte-identity — every GENERATOR_MAP method produces the same statement
+  through the engine, the legacy flush path, and a solo backend;
+* page-pool soundness — all-or-nothing allocation, no aliasing under
+  churn, double/foreign frees raise;
+* graceful OOM — a request that can never fit the pool gets the serving
+  tier's typed ``SchedulerRejected("kv_oom")``, not a crash;
+* interleaved chunked prefill never perturbs decode results;
+* cancellation evicts resident rows and returns their KV pages;
+* engine mode keeps ``flush_reason="timeout"`` unreachable and
+  ``batching_spurious_wakeups_total`` at 0, and stays recompile-flat
+  across ragged load.
+"""
+
+import threading
+import time
+
+import pytest
+
+from consensus_tpu.backends.base import GenerationRequest, RequestCancelled
+from consensus_tpu.backends.batching import BatchingBackend
+from consensus_tpu.backends.engine import DecodeEngine
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.methods import get_method_generator
+from consensus_tpu.obs.backends import bucket_recompiles
+from consensus_tpu.obs.metrics import Registry, diff_snapshots
+from consensus_tpu.ops.kv_pages import BlockTable, PagePool, PagePoolExhausted
+
+ISSUE = "Should the city invest in more bike lanes?"
+OPINIONS = {
+    "Agent 1": "Bike lanes make streets safer and should be expanded.",
+    "Agent 2": "Road space is scarce; cars and buses need priority.",
+    "Agent 3": "Invest only where cycling demand is proven.",
+}
+
+#: Small-but-real params for every method in GENERATOR_MAP (same settings
+#: the per-method suites use, so any drift shows up in one place).
+METHOD_PARAMS = {
+    "zero_shot": {"seed": 42, "max_tokens": 30},
+    "predefined": {"predefined_statement": "Exactly this statement."},
+    "best_of_n": {"num_best_of_n": 4, "seed": 7, "max_tokens": 24},
+    "beam_search": {"beam_width": 2, "max_tokens": 6, "seed": 5},
+    "finite_lookahead": {
+        "branching_factor": 2, "max_depth": 2, "max_tokens": 5, "seed": 9,
+    },
+    "mcts": {
+        "num_simulations": 4, "expansion_sample_width": 3, "max_tokens": 4,
+        "rollout_depth": 3, "seed": 2,
+    },
+    "habermas_machine": {
+        "num_candidates": 3, "num_rounds": 1, "seed": 42, "max_tokens": 64,
+    },
+}
+
+
+def _counter_total(registry, name, **labels):
+    family = registry.snapshot()["families"].get(name)
+    total = 0.0
+    for series in (family or {}).get("series", ()):
+        if all(series["labels"].get(k) == v for k, v in labels.items()):
+            total += series["value"]
+    return total
+
+
+def _wait_until(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Page pool / block table
+# ---------------------------------------------------------------------------
+
+
+class TestPagePool:
+    def test_alloc_free_roundtrip(self):
+        pool = PagePool(8, page_size=4)
+        pages = pool.alloc(3, owner="a")
+        assert len(set(pages)) == 3
+        assert pool.in_use == 3 and pool.free_count == 5
+        pool.free(pages)
+        assert pool.in_use == 0 and pool.free_count == 8
+
+    def test_exhaustion_is_all_or_nothing(self):
+        pool = PagePool(4, page_size=4)
+        pool.alloc(3, owner="a")
+        with pytest.raises(PagePoolExhausted):
+            pool.alloc(2, owner="b")
+        # The failed alloc must not have consumed the last free page.
+        assert pool.free_count == 1
+        pool.alloc(1, owner="b")
+
+    def test_double_free_raises(self):
+        pool = PagePool(4)
+        pages = pool.alloc(2)
+        pool.free(pages)
+        with pytest.raises(ValueError, match="double free|not allocated"):
+            pool.free(pages)
+
+    def test_foreign_page_free_raises(self):
+        pool = PagePool(4)
+        with pytest.raises(ValueError):
+            pool.free([99])
+
+    def test_no_aliasing_under_churn(self):
+        """Interleaved alloc/free never hands one page to two live owners."""
+        pool = PagePool(16, page_size=4)
+        live = {}
+        for step in range(200):
+            if step % 3 == 2 and live:
+                victim = sorted(live)[step % len(live)]
+                pool.free(live.pop(victim))
+            else:
+                n = 1 + step % 3
+                if n <= pool.free_count:
+                    live[step] = pool.alloc(n, owner=step)
+            held = [p for pages in live.values() for p in pages]
+            assert len(held) == len(set(held))  # no page in two hands
+            assert pool.in_use == len(held)
+        assert pool.stats().high_water <= pool.num_pages
+
+    def test_pages_for_tokens_ceil(self):
+        pool = PagePool(8, page_size=16)
+        assert pool.pages_for_tokens(0) == 0
+        assert pool.pages_for_tokens(1) == 1
+        assert pool.pages_for_tokens(16) == 1
+        assert pool.pages_for_tokens(17) == 2
+
+
+class TestBlockTable:
+    def test_append_allocates_on_page_boundaries_only(self):
+        pool = PagePool(8, page_size=4)
+        table = BlockTable(0)
+        assert len(table.append_tokens(pool, 3)) == 1  # first page
+        assert table.append_tokens(pool, 1) == []  # fills page 0
+        assert len(table.append_tokens(pool, 5)) == 2  # crosses into 2 more
+        assert table.num_tokens == 9 and len(table.pages) == 3
+
+    def test_write_cursor_tracks_last_token(self):
+        pool = PagePool(8, page_size=4)
+        table = BlockTable(0)
+        table.append_tokens(pool, 5)
+        page, offset = table.write_cursor(pool)
+        assert page == table.pages[1] and offset == 0
+
+    def test_release_returns_everything(self):
+        pool = PagePool(8, page_size=4)
+        table = BlockTable(0)
+        table.append_tokens(pool, 9)
+        table.release(pool)
+        assert pool.in_use == 0 and table.num_tokens == 0
+
+    def test_as_array_pads_and_bounds(self):
+        pool = PagePool(8, page_size=4)
+        table = BlockTable(0)
+        table.append_tokens(pool, 6)
+        arr = table.as_array(4)
+        assert arr.tolist()[:2] == table.pages and set(arr.tolist()[2:]) == {-1}
+        with pytest.raises(ValueError, match="max_blocks"):
+            table.as_array(1)
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity: engine vs legacy flush vs solo, all seven methods
+# ---------------------------------------------------------------------------
+
+
+class TestByteIdentity:
+    @pytest.mark.parametrize("method", sorted(METHOD_PARAMS))
+    def test_engine_matches_legacy_and_solo(self, method):
+        params = METHOD_PARAMS[method]
+        solo = get_method_generator(
+            method, FakeBackend(), dict(params)
+        ).generate_statement(ISSUE, OPINIONS)
+
+        legacy = BatchingBackend(FakeBackend(), flush_ms=1.0)
+        via_legacy = get_method_generator(
+            method, legacy, dict(params)
+        ).generate_statement(ISSUE, OPINIONS)
+
+        engined = BatchingBackend(
+            FakeBackend(), engine=True,
+            engine_options={"slots": 4, "num_pages": 512},
+        )
+        try:
+            via_engine = get_method_generator(
+                method, engined, dict(params)
+            ).generate_statement(ISSUE, OPINIONS)
+        finally:
+            engined.close()
+
+        assert via_engine == solo, f"{method}: engine result diverged"
+        assert via_legacy == solo, f"{method}: legacy result diverged"
+
+
+# ---------------------------------------------------------------------------
+# Scheduling semantics (deterministic stepping via auto_start=False)
+# ---------------------------------------------------------------------------
+
+
+def _submit_async(engine, requests, probe=None):
+    """Run ``engine.submit`` in a thread; returns (thread, outbox dict)."""
+    out = {}
+
+    def worker():
+        try:
+            out["result"] = engine.submit("generate", requests, probe=probe)
+        except BaseException as exc:  # noqa: BLE001 - test captures verbatim
+            out["error"] = exc
+
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
+    return thread, out
+
+
+class TestEngineScheduling:
+    def test_full_slot_table_occupancy(self):
+        """8 co-batched statements keep the whole slot table busy —
+        occupancy mean >= 0.8 is the BENCH_ENGINE acceptance floor."""
+        reg = Registry()
+        engine = DecodeEngine(
+            FakeBackend(), slots=8, num_pages=512, auto_start=False,
+            registry=reg,
+        )
+        threads = []
+        for i in range(8):
+            t, _ = _submit_async(
+                engine,
+                [GenerationRequest(
+                    user_prompt=f"prompt {i} with a few extra words",
+                    max_tokens=8, seed=i,
+                )],
+            )
+            threads.append(t)
+        assert _wait_until(lambda: engine.stats()["queue_depth"] == 8)
+        engine.run_iteration()
+        for t in threads:
+            t.join(timeout=5.0)
+        stats = engine.stats()
+        assert stats["slot_occupancy_mean"] >= 0.8
+        assert stats["slots_occupied"] == 0  # everything retired
+        assert engine.pool.in_use == 0
+
+    def test_admission_is_reservation_bounded(self):
+        """Admission reserves prompt+max_tokens pages, so a resident row can
+        always finish; the backlog holds FIFO until pages free up."""
+        engine = DecodeEngine(
+            FakeBackend(), slots=4, page_size=4, num_pages=8,
+            auto_start=False, min_fill=1,
+        )
+        # Each request needs ceil((5 + 12)/4) = 5 pages; two can't coexist
+        # in an 8-page pool.
+        reqs = [
+            GenerationRequest(
+                user_prompt="one two three four five", max_tokens=12, seed=i,
+            )
+            for i in range(2)
+        ]
+        threads = [_submit_async(engine, [r])[0] for r in reqs]
+        assert _wait_until(lambda: engine.stats()["queue_depth"] == 2)
+        engine.run_iteration()
+        stats = engine.stats()
+        assert stats["kv_pages_reserved"] <= 8
+        # Second row waited its turn; a later iteration retires it too.
+        for _ in range(4):
+            engine.run_iteration()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert engine.stats()["kv_pages_reserved"] == 0
+        assert engine.pool.in_use == 0
+
+    def test_oversized_request_rejected_as_kv_oom(self):
+        from consensus_tpu.serve.scheduler import SchedulerRejected
+
+        backend = BatchingBackend(
+            FakeBackend(), engine=True,
+            engine_options={"slots": 2, "page_size": 4, "num_pages": 2},
+        )
+        try:
+            with pytest.raises(SchedulerRejected) as excinfo:
+                backend.generate(
+                    [GenerationRequest(
+                        user_prompt="this prompt is fine",
+                        max_tokens=256, seed=0,
+                    )]
+                )
+        finally:
+            backend.close()
+        assert excinfo.value.reason == "kv_oom"
+
+    def test_interleaved_prefill_does_not_perturb_decode(self):
+        """A second request arriving mid-prefill (chunk=1 drip) must not
+        change the first request's tokens — token-for-token vs solo."""
+        reqs = [
+            GenerationRequest(
+                user_prompt="alpha beta gamma delta epsilon zeta",
+                max_tokens=8, seed=11,
+            ),
+            GenerationRequest(
+                user_prompt="one two three four five six seven eight nine",
+                max_tokens=8, seed=12,
+            ),
+        ]
+        solo = FakeBackend().generate(reqs)
+
+        engine = DecodeEngine(
+            FakeBackend(), slots=2, page_size=4, num_pages=64,
+            prefill_chunk=1, min_fill=1, auto_start=False,
+        )
+        t1, out1 = _submit_async(engine, [reqs[0]])
+        assert _wait_until(lambda: engine.stats()["queue_depth"] == 1)
+        engine.run_iteration()  # admit + first 1-token prefill chunk
+        assert engine.stats()["slots_occupied"] == 1
+        t2, out2 = _submit_async(engine, [reqs[1]])
+        assert _wait_until(lambda: engine.stats()["queue_depth"] == 1)
+        for _ in range(40):
+            if out1 and out2:
+                break
+            engine.run_iteration()
+        t1.join(timeout=5.0)
+        t2.join(timeout=5.0)
+        assert out1["result"][0].text == solo[0].text
+        assert out2["result"][0].text == solo[1].text
+        assert engine.pool.in_use == 0
+
+    def test_cancellation_evicts_and_frees_pages(self):
+        reg = Registry()
+        engine = DecodeEngine(
+            FakeBackend(), slots=2, page_size=4, num_pages=64,
+            prefill_chunk=2, auto_start=False, registry=reg,
+        )
+        flag = {"cancelled": False}
+        thread, out = _submit_async(
+            engine,
+            [GenerationRequest(
+                user_prompt="one two three four five six seven eight",
+                max_tokens=4, seed=3,
+            )],
+            probe=lambda: flag["cancelled"],
+        )
+        assert _wait_until(lambda: engine.stats()["queue_depth"] == 1)
+        engine.run_iteration()  # admit + partial prefill (2 of 8 tokens)
+        assert engine.stats()["slots_occupied"] == 1
+        assert engine.pool.in_use > 0
+        flag["cancelled"] = True
+        engine.run_iteration()
+        thread.join(timeout=5.0)
+        assert isinstance(out.get("error"), RequestCancelled)
+        assert engine.pool.in_use == 0
+        assert engine.stats()["slots_occupied"] == 0
+        assert _counter_total(reg, "engine_evicted_total") >= 1
+
+    def test_submit_after_close_raises(self):
+        engine = DecodeEngine(FakeBackend(), auto_start=False)
+        engine.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            engine.submit(
+                "generate",
+                [GenerationRequest(user_prompt="late", max_tokens=4, seed=0)],
+            )
+
+
+# ---------------------------------------------------------------------------
+# Obs pins: no timeout flushes, no spurious wakeups, recompile-flat
+# ---------------------------------------------------------------------------
+
+
+class TestEngineObservability:
+    def _run_ragged_load(self, registry, inner=None):
+        inner = inner if inner is not None else FakeBackend(registry=registry)
+        backend = BatchingBackend(
+            inner, engine=True, registry=registry,
+            engine_options={"slots": 4, "num_pages": 512},
+        )
+        results = {}
+
+        def worker(i):
+            with backend.session():
+                results[i] = backend.generate(
+                    [GenerationRequest(
+                        user_prompt="word " * (3 + 7 * i),  # ragged lengths
+                        max_tokens=8, seed=i,
+                    )]
+                )[0]
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        backend.close()
+        assert len(results) == 6
+        return backend
+
+    def test_no_timeout_flushes_and_no_spurious_wakeups(self):
+        reg = Registry()
+        self._run_ragged_load(reg)
+        assert _counter_total(
+            reg, "batching_flushes_total", reason="timeout") == 0
+        assert _counter_total(reg, "batching_flushes_total") == 0
+        assert _counter_total(reg, "batching_spurious_wakeups_total") == 0
+
+    def test_engine_metric_families_recorded(self):
+        reg = Registry()
+        self._run_ragged_load(reg)
+        snap = reg.snapshot()["families"]
+        assert "engine_slot_occupancy" in snap
+        assert _counter_total(reg, "engine_admitted_total") >= 6
+        assert _counter_total(reg, "engine_prefill_chunks_total") >= 6
+        tokens_iter = snap["engine_tokens_per_iteration"]["series"]
+        assert tokens_iter and tokens_iter[0]["count"] >= 1
+        pages = snap["kv_pages_in_use"]["series"]
+        assert pages and pages[0]["max"] >= 1
+
+    def test_bucket_recompiles_flat_across_ragged_load(self):
+        """Slot lengths are data, not shapes: after warmup, ragged prompt
+        lengths must add zero new compiled program shapes."""
+        reg = Registry()
+        inner = FakeBackend(registry=reg)
+        self._run_ragged_load(reg, inner)  # warmup: first shape sightings
+        cut = reg.snapshot()
+        self._run_ragged_load(reg, inner)  # same bucketed shapes, new lengths
+        delta = diff_snapshots(cut, reg.snapshot())
+        assert bucket_recompiles(delta) == 0
+
+    def test_engine_stats_surface(self):
+        backend = BatchingBackend(
+            FakeBackend(), engine=True,
+            engine_options={"slots": 4, "num_pages": 128},
+        )
+        try:
+            backend.generate(
+                [GenerationRequest(user_prompt="hello", max_tokens=4, seed=0)]
+            )
+            stats = backend.engine.stats()
+        finally:
+            backend.close()
+        assert stats["slots"] == 4
+        assert stats["kv_pages"] == 128
+        assert stats["iterations"] >= 1
+        assert stats["kv_pages_high_water"] >= 1
+        assert backend.batch_counts["generate"] >= 1  # aliased dispatch count
+
+
+# ---------------------------------------------------------------------------
+# Paged slot programs: token-for-token vs the dense forward pass
+# ---------------------------------------------------------------------------
+
+
+class TestPagedProgramNumerics:
+    """Chunked paged prefill + paged decode must reproduce the dense
+    ``forward`` pass exactly — same greedy tokens AND close logits — with
+    the second slot idle (writes routed to the sink page)."""
+
+    @pytest.mark.parametrize("cfg_name", ["tiny-gemma2", "tiny-llama3"])
+    def test_matches_dense_forward(self, cfg_name):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from consensus_tpu.models import stepper
+        from consensus_tpu.models.config import get_model_config
+        from consensus_tpu.models.transformer import (
+            forward, init_params, make_cache, project_logits,
+        )
+
+        cfg = get_model_config(cfg_name)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, cfg.vocab_size, size=(7,)).astype(np.int32)
+        n_decode = 5
+
+        # Dense reference: prefill then greedy decode through KVCache.
+        cache = make_cache(cfg, 1, 32, jnp.float32)
+        logits, cache = forward(
+            params, cfg, jnp.asarray(prompt)[None, :],
+            jnp.arange(7)[None, :], jnp.ones((1, 7), bool), cache, 0,
+        )
+        dense_tokens, dense_logits = [], []
+        last, cur = logits[0, -1], 7
+        for _ in range(n_decode):
+            nxt = int(jnp.argmax(last))
+            dense_tokens.append(nxt)
+            dense_logits.append(np.asarray(last))
+            lg, cache = forward(
+                params, cfg, jnp.asarray([[nxt]], jnp.int32),
+                jnp.asarray([[cur]], jnp.int32), jnp.ones((1, 1), bool),
+                cache, cur,
+            )
+            last, cur = lg[0, -1], cur + 1
+
+        # Paged path: 2 slots (slot 1 idle), 4-token prefill chunks.
+        page_size, num_pages, max_blocks, chunk = 4, 16, 8, 4
+        pool = PagePool(num_pages, page_size)
+        state = stepper.make_page_state(cfg, num_pages, page_size, jnp.float32)
+        sink = num_pages
+        table = BlockTable(0)
+
+        def write_cursors(n_new):
+            return [
+                (table.pages[t // page_size], t % page_size)
+                for t in range(table.num_tokens - n_new, table.num_tokens)
+            ]
+
+        def slot_arrays():
+            tables = np.full((2, max_blocks), -1, np.int32)
+            tables[0] = table.as_array(max_blocks)
+            lengths = np.array([table.num_tokens, 0], np.int32)
+            return jnp.asarray(tables), jnp.asarray(lengths)
+
+        hidden = None
+        for start in range(0, len(prompt), chunk):
+            piece = prompt[start : start + chunk]
+            table.append_tokens(pool, len(piece))
+            tok = np.zeros((2, chunk), np.int32)
+            cvalid = np.zeros((2, chunk), bool)
+            wp = np.full((2, chunk), sink, np.int32)
+            wo = np.zeros((2, chunk), np.int32)
+            tok[0, : len(piece)] = piece
+            cvalid[0, : len(piece)] = True
+            for j, (p, o) in enumerate(write_cursors(len(piece))):
+                wp[0, j], wo[0, j] = p, o
+            tables, lengths = slot_arrays()
+            hidden, state = stepper.paged_prefill_chunk(
+                params, cfg, jnp.asarray(tok), jnp.asarray(cvalid), state,
+                tables, lengths, jnp.asarray(wp), jnp.asarray(wo),
+            )
+        last = project_logits(params, cfg, hidden)[0]
+
+        paged_tokens = []
+        for step in range(n_decode):
+            nxt = int(jnp.argmax(last))
+            paged_tokens.append(nxt)
+            np.testing.assert_allclose(
+                np.asarray(last), dense_logits[step], rtol=2e-4, atol=2e-4,
+            )
+            table.append_tokens(pool, 1)
+            page, offset = table.write_cursor(pool)
+            tables, lengths = slot_arrays()
+            lg, state = stepper.paged_decode_step(
+                params, cfg, jnp.asarray([nxt, 0], jnp.int32), state,
+                tables, lengths,
+                jnp.asarray([page, sink], np.int32),
+                jnp.asarray([offset, 0], np.int32),
+            )
+            last = lg[0]
+        assert paged_tokens == dense_tokens
