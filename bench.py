@@ -410,6 +410,72 @@ def main() -> None:
             "brownout_offered_rate_rps": brownout_rate,
         }
 
+    # ---- fleet cell: N replicas + mid-run replica kill ----------------
+    # The PR 7 acceptance surface measured: the same open-loop workload
+    # against (a) one capacity-constrained scheduler and (b) a 3-replica
+    # fleet with one replica killed mid-run.  Availability should hold at
+    # 1.0 through the kill (failed-over requests re-dispatch under their
+    # original deadline, byte-identical), and scaling efficiency =
+    # fleet_rps / (replicas * single_rps) reports how much of the N-x
+    # capacity the router actually delivers.  BENCH_FLEET=0 skips.
+    fleet_extra = {}
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        import threading as _threading
+
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+
+        fleet_requests = int(os.environ.get("BENCH_FLEET_REQUESTS", "48"))
+        fleet_rate = float(os.environ.get("BENCH_FLEET_RATE", "100"))
+        fleet_n = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+        fleet_payloads = scenario_requests(
+            fleet_requests, params={"n": 8, "max_tokens": NEW_TOKENS},
+            timeout_s=30.0,
+        )
+        capacity = {"max_inflight": 2, "max_queue_depth": 8,
+                    "default_timeout_s": 30.0}
+
+        server = create_server(backend="fake", port=0, **capacity).start()
+        try:
+            single_report = run_loadgen(
+                server.base_url, fleet_payloads, rate_rps=fleet_rate)
+        finally:
+            server.stop()
+        single_rps = single_report["throughput_rps"]
+
+        server = create_server(
+            backend="fake", port=0, fleet_size=fleet_n, **capacity).start()
+        kill_at_s = 0.4 * fleet_requests / fleet_rate
+        killer = _threading.Timer(
+            kill_at_s, server.scheduler.kill_replica, args=("r0",))
+        killer.daemon = True
+        try:
+            killer.start()
+            fleet_report = run_loadgen(
+                server.base_url, fleet_payloads, rate_rps=fleet_rate)
+        finally:
+            killer.cancel()
+            server.stop()
+        fleet_rps = fleet_report["throughput_rps"]
+        fleet_extra = {
+            "fleet_replicas": fleet_n,
+            "fleet_availability": fleet_report["availability"],
+            "fleet_failovers": fleet_report.get("fleet", {}).get(
+                "failovers", 0),
+            "fleet_failover_fraction": fleet_report.get(
+                "failover_fraction", 0.0),
+            "fleet_throughput_rps": fleet_rps,
+            "fleet_single_replica_rps": single_rps,
+            "fleet_scaling_efficiency": round(
+                fleet_rps / (fleet_n * single_rps), 4
+            ) if single_rps else None,
+            "fleet_replica_request_counts": fleet_report.get(
+                "replica_request_counts", {}),
+            "fleet_kill_at_s": round(kill_at_s, 3),
+            "fleet_requests": fleet_requests,
+            "fleet_offered_rate_rps": fleet_rate,
+        }
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -518,6 +584,7 @@ def main() -> None:
                     **serve_extra,
                     **chaos_extra,
                     **brownout_extra,
+                    **fleet_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

@@ -31,7 +31,8 @@ from consensus_tpu.backends.fake import FakeBackend  # noqa: F401
 _BACKEND_CACHE: Dict[str, Backend] = {}
 
 
-def get_backend(spec: Optional[Any] = None, **kwargs) -> Backend:
+def get_backend(spec: Optional[Any] = None, *, fresh: bool = False,
+                **kwargs) -> Backend:
     """Resolve a backend from a name, config dict, or pass through an instance.
 
     Accepted specs:
@@ -41,6 +42,11 @@ def get_backend(spec: Optional[Any] = None, **kwargs) -> Backend:
       * ``"openai"``           -> :class:`~consensus_tpu.backends.api.OpenAIBackend` (LLM judge)
       * ``{"name": ..., ...}`` -> as above with constructor kwargs
       * an object already implementing :class:`Backend` -> returned unchanged
+
+    ``fresh=True`` bypasses the cache in both directions: the caller gets
+    its own instance and the cache is not polluted with it.  Fleet serving
+    uses this — replicas must NOT alias one engine through the cache, or a
+    single injected device loss would take down every "replica" at once.
     """
     if spec is None:
         spec = "fake"
@@ -58,6 +64,8 @@ def get_backend(spec: Optional[Any] = None, **kwargs) -> Backend:
     try:
         cache_key = f"{name}:{sorted(kwargs.items())!r}"
     except TypeError:  # unhashable/unsortable kwargs: skip caching
+        cache_key = None
+    if fresh:
         cache_key = None
     if cache_key and cache_key in _BACKEND_CACHE:
         return _BACKEND_CACHE[cache_key]

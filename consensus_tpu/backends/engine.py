@@ -58,6 +58,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from consensus_tpu.backends.base import (
+    BackendLostError,
     PartialBatchError,
     RequestCancelled,
 )
@@ -222,6 +223,11 @@ class DecodeEngine:
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
         self._reserved_pages = 0
         self._stopped = False
+        #: Latched when a dispatch raises BackendLostError: the device under
+        #: this engine is gone for good (BackendLostError is sticky by
+        #: contract).  Fleet replica health checks read this directly — a
+        #: plain bool read, no lock — as the passive loss signal.
+        self.backend_lost = False
         self.iterations = 0
         self._occ_sum = 0.0
         self._occ_iters = 0
@@ -305,6 +311,7 @@ class DecodeEngine:
                 "kv_pages_high_water": pool.high_water,
                 "fused_search_sessions": self._search_sessions,
                 "fused_search_slots": self._search_slots,
+                "backend_lost": self.backend_lost,
             }
 
     # -- loop --------------------------------------------------------------
@@ -487,6 +494,8 @@ class DecodeEngine:
             row_errors = dict(exc.row_errors)
         except Exception as exc:
             batch_error = exc
+            if isinstance(exc, BackendLostError):
+                self.backend_lost = True
 
         with self._lock:
             tokens = 0
@@ -548,6 +557,8 @@ class DecodeEngine:
                 cursor += n
                 item.event.set()
         except Exception as exc:
+            if isinstance(exc, BackendLostError):
+                self.backend_lost = True
             for item in items:
                 item.error = exc
                 item.event.set()

@@ -22,9 +22,12 @@ Fault kinds (``FaultSpec.kind``):
   does not come back).
 
 Firing is per-op and per-call-index: ``call_index`` pins a spec to the
-N-th call of that op (exact), ``rate`` fires pseudo-randomly via a seeded
-hash of ``(plan seed, spec index, op, call index)`` — deterministic given
-the call order.  Injections are counted in ``faults_injected_total{kind,op}``.
+N-th call of that op (exact), ``after_s`` pins it to the first matching
+call at/after that much wall-clock time since backend construction (the
+replica-loss spec fleet chaos runs arm per replica), and ``rate`` fires
+pseudo-randomly via a seeded hash of
+``(plan seed, spec index, op, call index)`` — deterministic given the
+call order.  Injections are counted in ``faults_injected_total{kind,op}``.
 """
 
 from __future__ import annotations
@@ -82,6 +85,12 @@ class FaultSpec:
     #: Exact per-op call index to fire at (0-based).  Mutually exclusive
     #: with ``rate`` in spirit; when set, ``rate`` is ignored.
     call_index: Optional[int] = None
+    #: Fire on the first matching call at/after this many wall-clock
+    #: seconds since the backend was constructed (checked after
+    #: ``call_index``, before ``rate``).  With ``kind="device_lost"`` this
+    #: is the "replica lost after N seconds" chaos spec: deterministic per
+    #: replica given its own FaultInjectingBackend and clock.
+    after_s: Optional[float] = None
     #: Seeded per-call firing probability when ``call_index`` is None.
     rate: float = 0.0
     #: Row to poison for nan/inf/truncate faults (None = every row).
@@ -99,15 +108,20 @@ class FaultSpec:
             raise ValueError(f"unknown op {self.op!r}; expected {OPS} or '*'")
         if not 0.0 <= self.rate <= 1.0:
             raise ValueError(f"rate must be in [0, 1], got {self.rate}")
+        if self.after_s is not None and self.after_s < 0:
+            raise ValueError(f"after_s must be >= 0, got {self.after_s}")
 
     def matches(self, op: str) -> bool:
         return self.op == "*" or self.op == op
 
-    def fires(self, seed: int, spec_index: int, op: str, call_index: int) -> bool:
+    def fires(self, seed: int, spec_index: int, op: str, call_index: int,
+              elapsed_s: float = 0.0) -> bool:
         if not self.matches(op):
             return False
         if self.call_index is not None:
             return call_index == self.call_index
+        if self.after_s is not None:
+            return elapsed_s >= self.after_s
         if self.rate <= 0.0:
             return False
         return _hash_unit(seed, spec_index, op, call_index) < self.rate
@@ -140,11 +154,28 @@ class FaultPlan:
         )
         return cls(seed=int(spec.get("seed", 0)), faults=faults)
 
-    def firing(self, op: str, call_index: int) -> List[FaultSpec]:
-        """Specs that fire for this (op, per-op call index)."""
+    @classmethod
+    def replica_lost(cls, after_s: Optional[float] = None,
+                     call_index: Optional[int] = None,
+                     op: str = "*", seed: int = 0) -> "FaultPlan":
+        """A single sticky ``device_lost`` spec: the replica dies at the
+        given wall-clock time OR per-op call index and never comes back —
+        the deterministic kill fleet failover tests and ``BENCH_FLEET``
+        chaos runs arm on one replica's backend."""
+        if (after_s is None) == (call_index is None):
+            raise ValueError(
+                "replica_lost needs exactly one of after_s / call_index")
+        return cls(seed=seed, faults=(FaultSpec(
+            kind="device_lost", op=op, call_index=call_index,
+            after_s=after_s,
+        ),))
+
+    def firing(self, op: str, call_index: int,
+               elapsed_s: float = 0.0) -> List[FaultSpec]:
+        """Specs that fire for this (op, per-op call index, elapsed time)."""
         return [
             spec for i, spec in enumerate(self.faults)
-            if spec.fires(self.seed, i, op, call_index)
+            if spec.fires(self.seed, i, op, call_index, elapsed_s)
         ]
 
 
@@ -165,10 +196,13 @@ class FaultInjectingBackend:
         plan: Union[FaultPlan, Dict[str, Any], str],
         registry: Optional[Registry] = None,
         sleep=time.sleep,
+        clock=time.monotonic,
     ):
         self.inner = inner
         self.plan = FaultPlan.from_spec(plan) or FaultPlan()
         self._sleep = sleep
+        self._clock = clock
+        self._t0 = clock()  # ``after_s`` specs measure from construction
         self._lock = threading.Lock()
         self._call_index = {op: 0 for op in OPS}
         self._device_lost = False
@@ -200,7 +234,7 @@ class FaultInjectingBackend:
     def _pre_call(self, op: str) -> List[FaultSpec]:
         """Apply call-blocking faults; return result-mutating specs."""
         index = self._next_index(op)
-        specs = self.plan.firing(op, index)
+        specs = self.plan.firing(op, index, self._clock() - self._t0)
         if self._device_lost or any(s.kind == "device_lost" for s in specs):
             if not self._device_lost:
                 self._injected.labels("device_lost", op).inc()

@@ -262,6 +262,11 @@ class SupervisedBackend:
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
         self.guard_nonfinite = bool(guard_nonfinite)
+        #: Latched when the INNER backend raises BackendLostError (not when
+        #: the breaker refuses a call): the device under this supervisor is
+        #: gone for good.  Fleet health checks read this as the passive
+        #: "replica lost" signal without waiting for the breaker to trip.
+        self.backend_lost = False
         self._sleep = sleep
         reg = registry if registry is not None else get_registry()
         self.circuit_breaker = breaker if breaker is not None else CircuitBreaker(
@@ -342,7 +347,10 @@ class SupervisedBackend:
         while True:
             try:
                 results = fn(requests)
-            except (BackendLostError, BackendIntegrityError, PartialBatchError):
+            except (BackendLostError, BackendIntegrityError,
+                    PartialBatchError) as exc:
+                if isinstance(exc, BackendLostError):
+                    self.backend_lost = True
                 self.circuit_breaker.record_failure()
                 raise
             except Exception as exc:

@@ -15,7 +15,9 @@ server; ``serve.loadgen`` replays AAMAS scenarios against it.
 """
 
 from consensus_tpu.serve.brownout import BrownoutController  # noqa: F401
+from consensus_tpu.serve.fleet import Replica  # noqa: F401
 from consensus_tpu.serve.http_frontend import ConsensusServer  # noqa: F401
+from consensus_tpu.serve.router import FleetRouter, FleetTicket  # noqa: F401
 from consensus_tpu.serve.scheduler import (  # noqa: F401
     RequestScheduler,
     RequestTimeout,
@@ -49,6 +51,8 @@ def create_server(
     anytime_margin_s: float = 0.2,
     engine: bool = False,
     engine_options=None,
+    fleet_size: int = 1,
+    fleet_options=None,
 ) -> ConsensusServer:
     """Wire backend → service → scheduler → HTTP server (not yet started).
 
@@ -67,9 +71,52 @@ def create_server(
     engine (``--engine`` on the CLI): same byte-identical results, no
     flush barrier, and /healthz gains slot-table + KV-page-pool pressure.
 
+    ``fleet_size > 1`` (or any ``fleet_options``) builds N full replica
+    stacks — each with its OWN backend instance, kill switch, supervisor +
+    breaker, optional brownout controller, and scheduler — behind a
+    :class:`FleetRouter` (health-gated routing, scenario affinity,
+    transparent failover, optional hedging and tier routing).
+    ``fleet_options`` keys: ``tiers`` (per-replica tier names; first tier
+    listed is the default/full tier), ``tier_backend_options`` (dict tier →
+    backend kwargs, e.g. a smaller model for the ``small`` tier),
+    ``fault_plans`` (per-replica FaultPlan list for chaos runs),
+    ``engine`` (per-replica bool list overriding the global ``engine``
+    flag — the flush-vs-engine merge layer is chosen PER REPLICA),
+    ``hedge_after_s``, ``probe_interval_s``, ``probe_timeout_s``,
+    ``tier_enter_pressure``, ``tier_exit_pressure``, ``tier_min_dwell_s``.
+
+    With ``fleet_size=1`` and no ``fleet_options`` the router is bypassed
+    entirely — the server runs the exact single-scheduler path below, so
+    responses stay byte-identical to that path (pinned in
+    tests/test_fleet.py).
+
     Defaults OFF so a quiet server's responses stay byte-identical to
     offline Experiment runs (pinned in tests/test_serve.py)."""
     from consensus_tpu.backends import get_backend, wrap_backend
+
+    if fleet_size > 1 or fleet_options:
+        return _create_fleet_server(
+            backend=backend,
+            backend_options=backend_options,
+            host=host,
+            port=port,
+            max_queue_depth=max_queue_depth,
+            max_inflight=max_inflight,
+            default_timeout_s=default_timeout_s,
+            max_retries=max_retries,
+            flush_ms=flush_ms,
+            generation_model=generation_model,
+            registry=registry,
+            fault_plan=fault_plan,
+            supervise=supervise,
+            brownout=brownout,
+            target_p95_ms=target_p95_ms,
+            anytime_margin_s=anytime_margin_s,
+            engine=engine,
+            engine_options=engine_options,
+            fleet_size=max(1, fleet_size),
+            fleet_options=dict(fleet_options or {}),
+        )
 
     inner = get_backend(backend, **(backend_options or {}))
     if fault_plan is not None or supervise:
@@ -101,3 +148,107 @@ def create_server(
         engine_options=engine_options,
     )
     return ConsensusServer(scheduler, host=host, port=port, registry=registry)
+
+
+def _create_fleet_server(
+    *,
+    backend,
+    backend_options,
+    host,
+    port,
+    max_queue_depth,
+    max_inflight,
+    default_timeout_s,
+    max_retries,
+    flush_ms,
+    generation_model,
+    registry,
+    fault_plan,
+    supervise,
+    brownout,
+    target_p95_ms,
+    anytime_margin_s,
+    engine,
+    engine_options,
+    fleet_size,
+    fleet_options,
+):
+    """Build N replica stacks behind a :class:`FleetRouter`.
+
+    Every replica gets its OWN backend instance (``get_backend`` with
+    ``fresh=True`` — cached instances would alias one device across
+    "replicas" and a single injected loss would kill them all), its own
+    breaker/supervisor (supervision defaults ON for fleets: the breaker is
+    the router's passive health signal), and optionally its own brownout
+    controller.  Scalar ``fault_plan`` arms every replica identically;
+    ``fleet_options["fault_plans"]`` is a per-replica list (``None``
+    entries = no chaos on that replica).
+    """
+    from consensus_tpu.backends import get_backend
+
+    tiers = fleet_options.get("tiers")
+    if tiers is not None and len(tiers) != fleet_size:
+        raise ValueError(
+            f"fleet_options['tiers'] has {len(tiers)} entries for "
+            f"fleet_size={fleet_size}"
+        )
+    tier_backend_options = fleet_options.get("tier_backend_options", {})
+    fault_plans = fleet_options.get("fault_plans")
+    if fault_plans is not None and len(fault_plans) != fleet_size:
+        raise ValueError(
+            f"fleet_options['fault_plans'] has {len(fault_plans)} entries "
+            f"for fleet_size={fleet_size}"
+        )
+    engines = fleet_options.get("engine")
+    if engines is not None and not isinstance(engines, (list, tuple)):
+        engines = [engines] * fleet_size
+
+    replicas = []
+    for i in range(fleet_size):
+        tier = tiers[i] if tiers is not None else "full"
+        options = dict(backend_options or {})
+        options.update(tier_backend_options.get(tier, {}))
+        inner = get_backend(backend, fresh=True, **options)
+        controller = None
+        if brownout:
+            controller = BrownoutController(
+                target_p95_s=(
+                    target_p95_ms / 1000.0 if target_p95_ms else None
+                ),
+                registry=registry,
+            )
+        plan = fault_plans[i] if fault_plans is not None else fault_plan
+        replicas.append(
+            Replica(
+                name=f"r{i}",
+                backend=inner,
+                tier=tier,
+                registry=registry,
+                fault_plan=plan,
+                supervise=supervise if supervise is not None else True,
+                brownout=controller,
+                generation_model=generation_model,
+                scheduler_options={
+                    "max_queue_depth": max_queue_depth,
+                    "max_inflight": max_inflight,
+                    "default_timeout_s": default_timeout_s,
+                    "max_retries": max_retries,
+                    "flush_ms": flush_ms,
+                    "anytime_margin_s": anytime_margin_s,
+                    "engine": engines[i] if engines is not None else engine,
+                    "engine_options": engine_options,
+                },
+            )
+        )
+    router = FleetRouter(
+        replicas,
+        registry=registry,
+        default_timeout_s=default_timeout_s,
+        hedge_after_s=fleet_options.get("hedge_after_s"),
+        probe_interval_s=fleet_options.get("probe_interval_s", 1.0),
+        probe_timeout_s=fleet_options.get("probe_timeout_s"),
+        tier_enter_pressure=fleet_options.get("tier_enter_pressure", 0.85),
+        tier_exit_pressure=fleet_options.get("tier_exit_pressure", 0.5),
+        tier_min_dwell_s=fleet_options.get("tier_min_dwell_s", 2.0),
+    )
+    return ConsensusServer(router, host=host, port=port, registry=registry)

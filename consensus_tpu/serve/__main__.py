@@ -68,6 +68,18 @@ def main(argv=None) -> int:
     parser.add_argument("--engine-options", default="{}",
                         help="JSON object of DecodeEngine kwargs (e.g. "
                              '\'{"slots": 16, "page_size": 16}\')')
+    parser.add_argument("--fleet", type=int, default=1, metavar="N",
+                        help="run N backend replicas behind the fleet "
+                             "router (health-gated routing, scenario "
+                             "affinity, transparent failover); 1 = "
+                             "single-scheduler path, router bypassed "
+                             "(default: 1)")
+    parser.add_argument("--fleet-options", default="{}",
+                        help="JSON object of fleet options: tiers, "
+                             "tier_backend_options, hedge_after_s, "
+                             "probe_timeout_s, engine (per-replica list — "
+                             "legacy flush vs --engine is chosen per "
+                             "replica), ... (see create_server docs)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -93,6 +105,8 @@ def main(argv=None) -> int:
         target_p95_ms=args.target_p95_ms,
         engine=args.engine,
         engine_options=json.loads(args.engine_options),
+        fleet_size=args.fleet,
+        fleet_options=json.loads(args.fleet_options) or None,
     )
     stop = threading.Event()
 
@@ -113,6 +127,7 @@ def main(argv=None) -> int:
         "max_inflight": args.max_inflight,
         "brownout": args.brownout or args.target_p95_ms is not None,
         "engine": args.engine,
+        "fleet": args.fleet,
     }))
     try:
         stop.wait()

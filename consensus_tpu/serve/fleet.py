@@ -1,0 +1,283 @@
+"""Replica lifecycle for fleet serving.
+
+A :class:`Replica` owns one complete single-replica serving stack — its
+own backend instance, fault-tolerance wrappers (fault injection below a
+supervised backend with its own circuit breaker), kill switch, optional
+brownout controller, :class:`~consensus_tpu.serve.service.ConsensusService`
+and :class:`~consensus_tpu.serve.scheduler.RequestScheduler` (which in turn
+owns the replica's ``BatchingBackend`` / ``DecodeEngine``).  The fleet
+router (``serve/router.py``) composes N of these: replica failure becomes
+an isolated, routable event instead of an outage.
+
+The wrapper stack, bottom to top::
+
+    supervisor( killswitch( faults( engine ) ) )
+
+* ``faults`` (optional) is the chaos seam — ``FaultPlan.replica_lost``
+  arms a deterministic per-replica death.
+* ``killswitch`` is the operational seam — ``Replica.kill()`` makes every
+  subsequent backend call raise ``BackendLostError``, exactly what a
+  preempted device looks like from above.  It sits ABOVE fault injection
+  (a killed replica stops injecting anything else) and BELOW the
+  supervisor (so the breaker records the loss and trips: the passive
+  health signal the router reads).
+* ``supervisor`` retries transients, bisects poison rows, and owns the
+  replica's :class:`~consensus_tpu.backends.supervisor.CircuitBreaker`.
+
+Health is a derived property, not a stored state: ``lost`` latches (from
+an explicit kill, a probe timeout, or the passive device-loss flags the
+supervisor and engine latch), draining follows the scheduler, and an open
+breaker demotes the replica to ``degraded`` — routable as a last resort,
+skipped while healthier peers exist.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from consensus_tpu.backends.base import Backend, BackendLostError
+from consensus_tpu.obs.metrics import Registry, get_registry
+from consensus_tpu.serve.brownout import BrownoutController
+from consensus_tpu.serve.scheduler import RequestScheduler
+from consensus_tpu.serve.service import ConsensusService
+
+#: Health states, in decreasing order of routability.
+HEALTHY = "healthy"
+DEGRADED = "degraded"  # breaker open: routable only as a last resort
+DRAINING = "draining"
+LOST = "lost"
+
+
+class ReplicaKillSwitch:
+    """Backend wrapper with an off button.
+
+    Until :meth:`kill`, every call passes straight through.  After it,
+    every call raises :class:`BackendLostError` — the sticky device-loss
+    contract, so the supervised stack above reacts exactly as it would to
+    a real preemption.  Deliberately does NOT expose
+    ``open_fused_token_search``: fused sessions bypass the protocol seam,
+    and a killed replica must be dead on EVERY path.
+    """
+
+    name = "killswitch"
+
+    def __init__(self, inner: Backend):
+        self.inner = inner
+        self._lost = threading.Event()
+        self._reason = ""
+
+    def kill(self, reason: str = "killed") -> None:
+        self._reason = reason
+        self._lost.set()
+
+    @property
+    def lost(self) -> bool:
+        return self._lost.is_set()
+
+    # -- passthrough surface ----------------------------------------------
+
+    @property
+    def deterministic_greedy(self) -> bool:
+        return bool(getattr(self.inner, "deterministic_greedy", False))
+
+    @property
+    def token_counts(self):
+        return getattr(self.inner, "token_counts", {})
+
+    # -- protocol -----------------------------------------------------------
+
+    def _check(self, op: str) -> None:
+        if self._lost.is_set():
+            raise BackendLostError(
+                f"replica backend is gone ({self._reason}); {op} refused"
+            )
+
+    def generate(self, requests):
+        self._check("generate")
+        return self.inner.generate(requests)
+
+    def score(self, requests):
+        self._check("score")
+        return self.inner.score(requests)
+
+    def next_token_logprobs(self, requests):
+        self._check("next_token_logprobs")
+        return self.inner.next_token_logprobs(requests)
+
+    def embed(self, texts):
+        self._check("embed")
+        return self.inner.embed(texts)
+
+
+class Replica:
+    """One backend replica: wrapped stack + service + scheduler + health."""
+
+    def __init__(
+        self,
+        name: str,
+        backend: Backend,
+        *,
+        tier: str = "full",
+        registry: Optional[Registry] = None,
+        fault_plan=None,
+        supervise=True,
+        brownout: Optional[BrownoutController] = None,
+        generation_model: str = "",
+        scheduler_options: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        self.tier = tier
+        reg = registry if registry is not None else get_registry()
+        stack: Backend = backend
+        if fault_plan is not None:
+            from consensus_tpu.backends.faults import FaultInjectingBackend
+
+            stack = FaultInjectingBackend(stack, fault_plan, registry=reg)
+        self.kill_switch = ReplicaKillSwitch(stack)
+        stack = self.kill_switch
+        self._supervisor = None
+        if supervise:
+            from consensus_tpu.backends.supervisor import (
+                CircuitBreaker,
+                SupervisedBackend,
+            )
+
+            options = dict(supervise) if isinstance(supervise, dict) else {}
+            breaker = CircuitBreaker(
+                failure_threshold=options.get("failure_threshold", 5),
+                cooldown_s=options.get("cooldown_s", 5.0),
+                registry=reg,
+                name=name,
+            )
+            stack = SupervisedBackend(
+                stack, breaker=breaker, registry=reg, **options
+            )
+            self._supervisor = stack
+        self.backend = stack
+        self.brownout = brownout
+        service = ConsensusService(stack, generation_model=generation_model)
+        self.scheduler = RequestScheduler(
+            handler=service.run,
+            backend=stack,
+            registry=reg,
+            brownout=brownout,
+            **(scheduler_options or {}),
+        )
+        self._lost = threading.Event()
+        self._lost_reason = ""
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Replica":
+        self.scheduler.start()
+        return self
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        self.scheduler.shutdown(drain=drain, timeout=timeout)
+
+    def kill(self, reason: str = "killed") -> None:
+        """Operational kill: the backend starts raising BackendLostError
+        (in-flight requests fail fast and fail over) and routing skips the
+        replica immediately."""
+        self.kill_switch.kill(reason)
+        self.mark_lost(reason)
+
+    def mark_lost(self, reason: str) -> None:
+        """Routing-only loss mark (probe timeout, observed BackendLostError):
+        the backend is left as-is — if it is truly gone its own calls keep
+        failing; marking just stops new placements."""
+        if not self._lost.is_set():
+            self._lost_reason = reason
+            self._lost.set()
+
+    # -- health -------------------------------------------------------------
+
+    @property
+    def lost(self) -> bool:
+        """Explicit mark, kill switch, or the passive device-loss flags the
+        supervisor / engine latched while serving."""
+        if self._lost.is_set() or self.kill_switch.lost:
+            return True
+        if self._supervisor is not None and getattr(
+            self._supervisor, "backend_lost", False
+        ):
+            return True
+        engine = self.scheduler.batching.engine
+        if engine is not None and engine.backend_lost:
+            return True
+        return False
+
+    @property
+    def health(self) -> str:
+        if self.lost:
+            return LOST
+        if self.scheduler.draining:
+            return DRAINING
+        breaker = self.scheduler.circuit_breaker
+        if breaker is not None and breaker.state == "open":
+            return DEGRADED
+        return HEALTHY
+
+    @property
+    def lost_reason(self) -> str:
+        if self._lost_reason:
+            return self._lost_reason
+        return "backend_lost" if self.lost else ""
+
+    def probe(self, timeout_s: float) -> bool:
+        """Active liveness probe: one tiny ``embed`` call against the
+        wrapped stack (below the batching layer, so it cannot jam the
+        request path), bounded by ``timeout_s``.  A hung or lost backend
+        marks the replica lost.  Off by default at the router (active
+        probes consume fault-plan call indices, which deterministic chaos
+        tests pin)."""
+        if self.lost:
+            return False
+        result: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def run() -> None:
+            try:
+                self.backend.embed(["__fleet_probe__"])
+                result["ok"] = True
+            except Exception as exc:  # noqa: BLE001 - classified below
+                result["error"] = exc
+            done.set()
+
+        thread = threading.Thread(
+            target=run, name=f"probe-{self.name}", daemon=True
+        )
+        thread.start()
+        if not done.wait(timeout_s):
+            self.mark_lost("probe_timeout")
+            return False
+        if "ok" in result:
+            return True
+        if isinstance(result.get("error"), BackendLostError):
+            self.mark_lost("backend_lost")
+        return False
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Per-replica /healthz block: tier, health, breaker, brownout,
+        occupancy — the router aggregates these."""
+        stats = self.scheduler.stats()
+        snap: Dict[str, Any] = {
+            "tier": self.tier,
+            "health": self.health,
+            "queue_depth": stats["queue_depth"],
+            "inflight": stats["inflight"],
+            "max_queue_depth": stats["max_queue_depth"],
+            "max_inflight": stats["max_inflight"],
+            "workers_alive": stats["workers_alive"],
+            "device_batches": stats["device_batches"],
+        }
+        if self.lost_reason:
+            snap["lost_reason"] = self.lost_reason
+        for key in ("engine", "circuit_breaker", "brownout"):
+            if key in stats:
+                snap[key] = stats[key]
+        return snap

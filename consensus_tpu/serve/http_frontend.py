@@ -5,8 +5,9 @@ dependencies) in front of :class:`RequestScheduler`:
 
 * ``POST /v1/consensus`` — validate → admit → wait → respond.  Errors are
   structured JSON (``{"error": {"type", "message", ...}}``) with the HTTP
-  status carrying the overload semantics: 400 validation, 429 admission
-  rejection (with ``Retry-After``), 503 circuit-breaker open
+  status carrying the overload semantics: 400 validation, 413 KV-footprint
+  too large for the engine's page pool (``kv_oom`` — not retryable), 429
+  admission rejection (with ``Retry-After``), 503 circuit-breaker open
   (``Retry-After`` = breaker cooldown), 504 deadline expiry with NO
   completed search wave (``Retry-After`` hint attached), 500 terminal
   backend failure.  A deadline expiry where at least one wave completed
@@ -157,21 +158,45 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
 
     def _send_rejection(self, exc: SchedulerRejected) -> None:
         """Admission rejections: 503 for an open circuit breaker (the
-        backend is down — clients should back off for its cooldown), 429
-        for overload (queue_full/draining — retry soon elsewhere)."""
-        status = 503 if exc.reason == "breaker_open" else 429
-        retry_after = exc.retry_after_s if exc.retry_after_s is not None else 1
+        backend is down — clients should back off for its cooldown), 413
+        for a request whose KV footprint exceeds the engine's page pool
+        (the REQUEST is too large — retrying unchanged can never succeed,
+        so no Retry-After), 429 for overload (queue_full/draining — retry
+        soon elsewhere)."""
+        if exc.reason == "breaker_open":
+            status = 503
+        elif exc.reason == "kv_oom":
+            status = 413
+        else:
+            status = 429
+        headers = None
+        if status != 413:
+            retry_after = (
+                exc.retry_after_s if exc.retry_after_s is not None else 1
+            )
+            headers = {"Retry-After": str(int(max(1, retry_after)))}
         self._send_json(status, {"error": {
             "type": "rejected",
             "reason": exc.reason,
             "message": str(exc),
-        }}, headers={"Retry-After": str(int(max(1, retry_after)))})
+        }}, headers=headers)
 
     def _health_payload(self) -> Dict[str, Any]:
         scheduler = self.server.scheduler
         stats = scheduler.stats()
         inner = scheduler.inner_backend
-        stats["status"] = "draining" if stats["draining"] else "ok"
+        if stats["draining"]:
+            stats["status"] = "draining"
+        elif (
+            "fleet" in stats
+            and stats["fleet"]["healthy"] < stats["fleet"]["size"]
+        ):
+            # Fleet-aggregated health: still serving, but with reduced
+            # redundancy — per-replica tier/breaker/brownout/occupancy is
+            # in stats["fleet"]["replicas"].
+            stats["status"] = "degraded"
+        else:
+            stats["status"] = "ok"
         stats["backend"] = {
             "name": getattr(inner, "name", type(inner).__name__),
             "model": getattr(inner, "model_name", ""),

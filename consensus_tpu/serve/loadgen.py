@@ -63,6 +63,9 @@ class RequestOutcome:
     statement: str = ""
     #: True when the 200 carried an anytime partial / browned-out result.
     degraded: bool = False
+    #: Fleet mode: which replica / model tier served the 200 ("" otherwise).
+    served_by: str = ""
+    served_tier: str = ""
 
 
 def _percentile(sorted_values: List[float], q: float) -> float:
@@ -110,6 +113,8 @@ def run_loadgen(
                     latency_s=time.perf_counter() - start,
                     statement=data.get("statement", ""),
                     degraded=bool(data.get("degraded", False)),
+                    served_by=str(data.get("served_by", "")),
+                    served_tier=str(data.get("served_tier", "")),
                 )
         except urllib.error.HTTPError as exc:
             try:
@@ -130,6 +135,7 @@ def run_loadgen(
                 error_type=type(exc).__name__,
             )
 
+    fleet_before = fetch_fleet_stats(base_url)
     threads: List[threading.Thread] = []
     start_wall = time.perf_counter()
     for i, payload in enumerate(payloads):
@@ -148,7 +154,7 @@ def run_loadgen(
     def classify(outcome: RequestOutcome) -> str:
         if outcome.status == 200:
             return "ok"
-        if outcome.status in (429, 503):  # overload / breaker open
+        if outcome.status in (413, 429, 503):  # too large/overload/breaker
             return "rejected"
         if outcome.status == 504 or outcome.error_type == "timeout":
             return "timeout"
@@ -193,7 +199,49 @@ def run_loadgen(
     tier_counts = fetch_tier_counts(base_url)
     if tier_counts is not None:
         report["tier_request_counts"] = tier_counts
+    fleet_after = fetch_fleet_stats(base_url)
+    if fleet_after is not None:
+        # Per-replica placement of the 200s (client view, from served_by)
+        # and the failover fraction over this run (server view, from the
+        # fleet counter delta — hedges excluded, failed-over-then-200 only).
+        replica_counts: Dict[str, int] = {}
+        for outcome in ok:
+            if outcome.served_by:
+                replica_counts[outcome.served_by] = (
+                    replica_counts.get(outcome.served_by, 0) + 1
+                )
+        before_failovers = (
+            fleet_before.get("failovers_total", 0) if fleet_before else 0
+        )
+        failovers = fleet_after.get("failovers_total", 0) - before_failovers
+        report["fleet"] = {
+            "size": fleet_after.get("size"),
+            "healthy": fleet_after.get("healthy"),
+            "lost": fleet_after.get("lost"),
+            "availability": fleet_after.get("availability"),
+            "serving_tier": fleet_after.get("serving_tier"),
+            "failovers": failovers,
+            "hedges_total": fleet_after.get("hedges_total", 0),
+        }
+        report["replica_request_counts"] = replica_counts
+        report["failover_fraction"] = (
+            round(failovers / len(ok), 4) if ok else 0.0
+        )
     return report
+
+
+def fetch_fleet_stats(base_url: str) -> Optional[Dict[str, Any]]:
+    """The ``fleet`` block of the server's /healthz; None when the server
+    is not running a fleet (single-scheduler bypass) or /healthz is down."""
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/healthz", timeout=5.0
+        ) as response:
+            health = json.loads(response.read().decode("utf-8"))
+    except Exception:
+        return None
+    fleet = health.get("fleet")
+    return dict(fleet) if isinstance(fleet, dict) else None
 
 
 def fetch_tier_counts(base_url: str) -> Optional[Dict[str, int]]:

@@ -219,8 +219,9 @@ class RequestScheduler:
             "serve_accepted_total", "Requests admitted to the queue.")
         self._m_rejected = reg.counter(
             "serve_rejected_total",
-            "Requests refused at admission, by reason "
-            "(queue_full|draining|stopped|breaker_open).",
+            "Requests refused by admission control, by reason "
+            "(queue_full|draining|stopped|breaker_open at the queue; "
+            "kv_oom from the engine's page-pool admission).",
             labels=("reason",),
         )
         self._m_timeout = reg.counter(
@@ -384,6 +385,12 @@ class RequestScheduler:
             tier=tier,
         )
 
+    @property
+    def draining(self) -> bool:
+        """Lock-free drain flag (a stale read is harmless — the fleet
+        router re-checks at submit, where the lock is taken)."""
+        return self._draining
+
     def stats(self) -> Dict[str, Any]:
         """Live occupancy for /healthz."""
         with self._lock:
@@ -479,6 +486,16 @@ class RequestScheduler:
                                  error=RequestTimeout(
                                      f"deadline expired during attempt "
                                      f"{ticket.attempts} ({type(exc).__name__})"))
+                    return
+                if isinstance(exc, SchedulerRejected):
+                    # Deferred admission rejection — the engine's page-pool
+                    # check (kv_oom) fires at schedule time, not submit
+                    # time.  It is deterministic (the request can NEVER
+                    # fit), so: no retry, counted as a rejection rather
+                    # than a backend failure, and re-raised to the HTTP
+                    # layer which maps kv_oom to 413.
+                    self._m_rejected.labels(exc.reason).inc()
+                    self._finish(ticket, method, "failed", error=exc)
                     return
                 if not self._should_retry(ticket, exc):
                     self._m_failed.inc()

@@ -62,6 +62,22 @@ def main(argv=None) -> int:
     parser.add_argument("--metrics-out", default=None,
                         help="write the serve-side registry snapshot delta "
                              "(metrics.json schema) here (self-contained)")
+    parser.add_argument("--fleet", type=int, default=1, metavar="N",
+                        help="(self-contained) run N backend replicas "
+                             "behind the fleet router; the report gains "
+                             "replica_request_counts and failover_fraction")
+    parser.add_argument("--fleet-options", default="{}",
+                        help="(self-contained) JSON object of fleet "
+                             "options (tiers, hedge_after_s, ...)")
+    parser.add_argument("--kill-replica-at-s", type=float, default=None,
+                        metavar="S",
+                        help="(self-contained, fleet) kill a replica S "
+                             "seconds into the run: its backend starts "
+                             "raising BackendLostError and in-flight "
+                             "requests fail over")
+    parser.add_argument("--kill-replica", default="r0", metavar="NAME",
+                        help="(self-contained, fleet) which replica "
+                             "--kill-replica-at-s kills (default: r0)")
     parser.add_argument("--fault-plan", default=None,
                         help="(self-contained) JSON fault plan injected "
                              "below a supervised backend, e.g. "
@@ -101,9 +117,25 @@ def main(argv=None) -> int:
             fault_plan=args.fault_plan,
             brownout=args.brownout or args.target_p95_ms is not None,
             target_p95_ms=args.target_p95_ms,
+            fleet_size=args.fleet,
+            fleet_options=json.loads(args.fleet_options) or None,
         ).start()
+        killer = None
+        if args.kill_replica_at_s is not None:
+            if args.fleet <= 1:
+                parser.error("--kill-replica-at-s needs --fleet > 1")
+            import threading
+
+            killer = threading.Timer(
+                args.kill_replica_at_s,
+                server.scheduler.kill_replica,
+                args=(args.kill_replica,),
+            )
+            killer.daemon = True
         before = get_registry().snapshot()
         try:
+            if killer is not None:
+                killer.start()
             report = run_loadgen(
                 server.base_url, payloads, args.rate,
                 client_timeout_s=args.client_timeout_s,
@@ -111,6 +143,8 @@ def main(argv=None) -> int:
             report["device_batches"] = server.scheduler.stats()[
                 "device_batches"]
         finally:
+            if killer is not None:
+                killer.cancel()
             server.stop()
         delta = diff_snapshots(before, get_registry().snapshot())
 

@@ -232,3 +232,106 @@ class TestPoisonRowIsolation:
                 assert results[i].logprobs == clean[i].logprobs
         assert 'batching_row_errors_total{kind="score"} 1' in \
             registry.to_prometheus()
+
+
+class TestEngineChaos:
+    """ISSUE-7 satellite: the chaos invariants hold through the
+    continuous-batching engine path — faults surface and resolve via
+    ``DecodeEngine.submit``, not just the legacy flush merge."""
+
+    @staticmethod
+    def _engine_stack(plan, registry, **engine_options):
+        stack = wrap_backend(
+            FakeBackend(), fault_plan=plan, supervise=True,
+            registry=registry)
+        options = {"slots": 4, "num_pages": 512}
+        options.update(engine_options)
+        return BatchingBackend(
+            stack, engine=True, engine_options=options, registry=registry)
+
+    def test_transient_fault_absorbed_below_engine_submit(self):
+        from consensus_tpu.obs.metrics import Registry
+
+        plan = {"seed": 7, "faults": [
+            {"kind": "transient_error", "op": "score", "call_index": 0}]}
+        registry = Registry()
+        batching = self._engine_stack(plan, registry)
+        reqs = [ScoreRequest(context="ctx", continuation=f"row {i}")
+                for i in range(3)]
+        try:
+            results = batching.score(reqs)
+        finally:
+            batching.close()
+        clean = FakeBackend().score(reqs)
+        assert [r.logprobs for r in results] == [r.logprobs for r in clean]
+        retries = sum(
+            s["value"] for s in registry.snapshot()["families"]
+            ["supervisor_retries_total"]["series"])
+        assert retries > 0
+
+    def test_nan_poison_row_fails_one_engine_session_siblings_identical(self):
+        # Three sessions submit one score row each into the engine; the
+        # fault poisons merged row 1 of the first device batch.  The
+        # supervisor bisects, the engine slices the PartialBatchError per
+        # item: exactly one session fails, typed, siblings bit-identical.
+        from consensus_tpu.obs.metrics import Registry
+
+        plan = {"faults": [
+            {"kind": "nan_logprobs", "op": "score", "call_index": 0,
+             "row_index": 1}]}
+        registry = Registry()
+        batching = self._engine_stack(plan, registry)
+        reqs = [ScoreRequest(context="ctx", continuation=f"row {i}")
+                for i in range(3)]
+        clean = FakeBackend().score(reqs)
+        results = {}
+
+        import threading
+
+        barrier = threading.Barrier(3)
+
+        def worker(i):
+            with batching.session():
+                barrier.wait(timeout=10)
+                try:
+                    results[i] = batching.score([reqs[i]])[0]
+                except Exception as exc:  # noqa: BLE001 - asserted below
+                    results[i] = exc
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        finally:
+            batching.close()
+
+        failed = [i for i in range(3) if isinstance(results[i], Exception)]
+        assert len(failed) == 1
+        assert isinstance(results[failed[0]], BackendIntegrityError)
+        for i in range(3):
+            if i not in failed:
+                assert results[i].logprobs == clean[i].logprobs
+
+    def test_device_lost_is_sticky_through_engine_submit(self):
+        from consensus_tpu.backends.base import BackendLostError
+        from consensus_tpu.obs.metrics import Registry
+
+        plan = {"faults": [
+            {"kind": "device_lost", "op": "score", "call_index": 0}]}
+        registry = Registry()
+        batching = self._engine_stack(plan, registry)
+        reqs = [ScoreRequest(context="ctx", continuation="row")]
+        try:
+            with pytest.raises(BackendLostError):
+                batching.score(reqs)
+            # The engine latched the loss (the fleet router's passive
+            # health signal) and stays lost for every later submit.
+            assert batching.engine.backend_lost
+            assert batching.engine.stats()["backend_lost"]
+            with pytest.raises(BackendLostError):
+                batching.score(reqs)
+        finally:
+            batching.close()

@@ -1,0 +1,536 @@
+"""Fleet serving: replica supervisor, health-gated failover routing,
+tiered degradation (ISSUE 7).
+
+The acceptance proofs:
+
+* **Failover bit-identity**: a 3-replica fleet at 3x single-replica
+  capacity, one replica killed mid-run — availability 1.0, every accepted
+  result byte-identical to a serial single-backend run (failed-over
+  requests included), ``fleet_failovers_total > 0``.
+* **Router bypass pin**: ``create_server(fleet_size=1)`` runs the exact
+  PR 6 single-scheduler path (no router object) and its responses stay
+  byte-identical to it.
+* **Tier routing**: under pressure the fleet lever routes to the smaller
+  model tier and stamps ``degraded`` / ``degraded_reason="tier_routed"``
+  / ``served_tier``.
+* **Hedging**: a tail-slow primary gets a duplicate dispatch after
+  ``hedge_after_s``; the fast copy wins, byte-identical.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consensus_tpu.backends import FakeBackend, get_backend
+from consensus_tpu.backends.base import BackendLostError
+from consensus_tpu.backends.faults import FaultPlan
+from consensus_tpu.obs.metrics import Registry
+from consensus_tpu.serve import (
+    ConsensusService,
+    FleetRouter,
+    Replica,
+    RequestScheduler,
+    SchedulerRejected,
+    create_server,
+    parse_request,
+)
+from consensus_tpu.serve.fleet import ReplicaKillSwitch
+from consensus_tpu.serve.router import _rendezvous_weight
+
+ISSUE = "Should we invest in public transport?"
+OPINIONS = {
+    "Agent 1": "Yes, buses are vital.",
+    "Agent 2": "Only with congestion pricing.",
+}
+
+
+def _payload(seed=7, issue=ISSUE, **overrides):
+    payload = {
+        "issue": issue,
+        "agent_opinions": dict(OPINIONS),
+        "method": "best_of_n",
+        "params": {"n": 2, "max_tokens": 16},
+        "seed": seed,
+        "request_id": f"req-{seed}",
+    }
+    payload.update(overrides)
+    return payload
+
+
+def _serial_statement(payload):
+    """The PR 6 ground truth: one fresh FakeBackend, no fleet, no merge."""
+    return ConsensusService(FakeBackend()).run(
+        parse_request(payload))["statement"]
+
+
+class SlowBackend:
+    """FakeBackend with a per-dispatch delay so kills land mid-flight."""
+
+    name = "slow-fake"
+
+    def __init__(self, delay_s=0.03):
+        self.inner = FakeBackend()
+        self.delay_s = delay_s
+
+    @property
+    def deterministic_greedy(self):
+        return self.inner.deterministic_greedy
+
+    @property
+    def token_counts(self):
+        return self.inner.token_counts
+
+    def generate(self, requests):
+        time.sleep(self.delay_s)
+        return self.inner.generate(requests)
+
+    def score(self, requests):
+        time.sleep(self.delay_s)
+        return self.inner.score(requests)
+
+    def next_token_logprobs(self, requests):
+        time.sleep(self.delay_s)
+        return self.inner.next_token_logprobs(requests)
+
+    def embed(self, texts):
+        time.sleep(self.delay_s)
+        return self.inner.embed(texts)
+
+
+def _fleet(n=3, *, registry=None, delay_s=0.03, tiers=None, backends=None,
+           scheduler_options=None, **router_kwargs):
+    registry = registry if registry is not None else Registry()
+    options = {"max_inflight": 2, "max_queue_depth": 6,
+               "default_timeout_s": 30.0}
+    options.update(scheduler_options or {})
+    replicas = [
+        Replica(
+            f"r{i}",
+            backends[i] if backends is not None else SlowBackend(delay_s),
+            tier=tiers[i] if tiers is not None else "full",
+            registry=registry,
+            scheduler_options=dict(options),
+        )
+        for i in range(n)
+    ]
+    return FleetRouter(replicas, registry=registry, **router_kwargs).start()
+
+
+# ---------------------------------------------------------------------------
+# kill switch + replica health
+# ---------------------------------------------------------------------------
+
+
+class TestKillSwitch:
+    def test_passthrough_until_killed_then_lost_on_every_op(self):
+        switch = ReplicaKillSwitch(FakeBackend())
+        assert len(switch.embed(["probe"])) == 1  # passes through
+        switch.kill("preempted")
+        for op in ("generate", "score", "next_token_logprobs", "embed"):
+            with pytest.raises(BackendLostError, match="preempted"):
+                getattr(switch, op)([])
+
+
+class TestReplicaHealth:
+    def test_health_ladder(self):
+        replica = Replica("r0", FakeBackend(), registry=Registry(),
+                          scheduler_options={"max_inflight": 1})
+        replica.start()
+        assert replica.health == "healthy"
+        replica.kill("test kill")
+        assert replica.lost and replica.health == "lost"
+        assert replica.lost_reason == "test kill"
+        replica.shutdown(drain=False, timeout=5.0)
+
+    def test_probe_timeout_marks_lost(self):
+        class HangingBackend(FakeBackend):
+            def embed(self, texts):
+                time.sleep(5.0)
+                return super().embed(texts)
+
+        replica = Replica("r0", HangingBackend(), registry=Registry(),
+                          supervise=False,
+                          scheduler_options={"max_inflight": 1})
+        assert replica.probe(timeout_s=0.1) is False
+        assert replica.lost and replica.lost_reason == "probe_timeout"
+
+    def test_passive_loss_from_supervisor_flag(self):
+        plan = FaultPlan.replica_lost(call_index=0, op="score")
+        replica = Replica("r0", FakeBackend(), registry=Registry(),
+                          fault_plan=plan,
+                          scheduler_options={"max_inflight": 1})
+        from consensus_tpu.backends import ScoreRequest
+
+        with pytest.raises(BackendLostError):
+            replica.backend.score(
+                [ScoreRequest(context="ctx", continuation="row")])
+        # The supervisor latched backend_lost; health derives it with no
+        # explicit mark.
+        assert replica.lost and replica.health == "lost"
+
+
+class TestReplicaLostFaultSpec:
+    def test_after_s_fires_deterministically_on_a_fake_clock(self):
+        from consensus_tpu.backends.faults import FaultInjectingBackend
+
+        now = [0.0]
+        backend = FaultInjectingBackend(
+            FakeBackend(), FaultPlan.replica_lost(after_s=5.0),
+            clock=lambda: now[0])
+        assert len(backend.embed(["ok"])) == 1  # t=0: before the deadline
+        now[0] = 4.99
+        assert len(backend.embed(["still ok"])) == 1
+        now[0] = 5.0
+        with pytest.raises(BackendLostError):
+            backend.embed(["gone"])
+        with pytest.raises(BackendLostError):  # sticky, like a real loss
+            backend.embed(["still gone"])
+
+    def test_call_index_variant_and_validation(self):
+        plan = FaultPlan.replica_lost(call_index=1, op="embed")
+        from consensus_tpu.backends.faults import FaultInjectingBackend
+
+        backend = FaultInjectingBackend(FakeBackend(), plan)
+        assert len(backend.embed(["call 0"])) == 1
+        with pytest.raises(BackendLostError):
+            backend.embed(["call 1"])
+        with pytest.raises(ValueError):
+            FaultPlan.replica_lost()
+        with pytest.raises(ValueError):
+            FaultPlan.replica_lost(after_s=1.0, call_index=1)
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+class TestRendezvousRouting:
+    def test_same_scenario_same_replica(self):
+        router = _fleet(3, delay_s=0.0)
+        try:
+            req = parse_request(_payload(seed=1))
+            first = router.route_for(req)
+            for seed in range(2, 6):
+                assert router.route_for(
+                    parse_request(_payload(seed=seed))) is first
+        finally:
+            router.shutdown(drain=False, timeout=5.0)
+
+    def test_only_the_dead_replicas_scenarios_move(self):
+        # Rendezvous minimal disruption: killing one replica remaps ONLY
+        # the scenarios it owned; everything else stays put.
+        names = ["r0", "r1", "r2"]
+        issues = [f"scenario {i}" for i in range(40)]
+
+        def winner(pool, issue):
+            return max(pool,
+                       key=lambda n: _rendezvous_weight(issue, n))
+
+        before = {issue: winner(names, issue) for issue in issues}
+        dead = "r1"
+        survivors = [n for n in names if n != dead]
+        for issue in issues:
+            after = winner(survivors, issue)
+            if before[issue] != dead:
+                assert after == before[issue]
+            else:
+                assert after in survivors
+
+    def test_draining_and_lost_replicas_are_not_candidates(self):
+        router = _fleet(3, delay_s=0.0)
+        try:
+            req = parse_request(_payload(seed=1))
+            primary = router.route_for(req)
+            router.kill_replica(primary.name)
+            rerouted = router.route_for(req)
+            assert rerouted is not None and rerouted is not primary
+        finally:
+            router.shutdown(drain=False, timeout=5.0)
+
+    def test_no_replica_rejection_when_everything_is_lost(self):
+        router = _fleet(2, delay_s=0.0)
+        try:
+            for replica in router.replicas:
+                router.kill_replica(replica.name)
+            with pytest.raises(SchedulerRejected) as excinfo:
+                router.submit(parse_request(_payload()))
+            assert excinfo.value.reason == "no_replica"
+        finally:
+            router.shutdown(drain=False, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# router bypass pin (fleet_size=1 == the PR 6 path)
+# ---------------------------------------------------------------------------
+
+
+class TestRouterBypass:
+    def test_fleet_size_one_is_the_single_scheduler_path(self):
+        server = create_server(
+            backend=FakeBackend(), port=0, registry=Registry()).start()
+        try:
+            # Literally the PR 6 object graph: a RequestScheduler, not a
+            # FleetRouter — bypass byte-identity is true by construction.
+            assert isinstance(server.scheduler, RequestScheduler)
+            assert not isinstance(server.scheduler, FleetRouter)
+        finally:
+            server.stop(drain=False, timeout=5.0)
+
+    def test_bypass_response_byte_identical_to_serial(self):
+        payload = _payload(seed=21)
+        server = create_server(
+            backend=FakeBackend(), port=0, registry=Registry()).start()
+        try:
+            status, body = _post(server.base_url, payload)
+        finally:
+            server.stop()
+        assert status == 200
+        assert body["statement"] == _serial_statement(payload)
+        # No fleet stamps on the bypass path.
+        assert "served_by" not in body and "served_tier" not in body
+
+
+# ---------------------------------------------------------------------------
+# failover acceptance
+# ---------------------------------------------------------------------------
+
+
+def _wait_all(tickets, timeout=60.0):
+    threads = []
+    for ticket in tickets:
+        thread = threading.Thread(
+            target=ticket.wait, args=(timeout,), daemon=True)
+        thread.start()
+        threads.append(thread)
+    return threads
+
+
+class TestFailoverAcceptance:
+    def test_three_replica_fleet_survives_mid_run_kill_byte_identical(self):
+        """The headline proof: 24 requests — more than 2x what one
+        replica can hold (max_inflight 2 + queue 8) and within the
+        3-replica fleet's aggregate capacity — one replica killed while
+        serving: zero rejections, availability 1.0, failovers > 0, and
+        every statement byte-identical to the serial single-backend run."""
+        capacity = {"max_inflight": 2, "max_queue_depth": 8,
+                    "default_timeout_s": 30.0}
+        # A single replica with the same limits cannot even ADMIT this
+        # burst — the fleet's capacity claim, measured not asserted.
+        single = _fleet(1, delay_s=0.03, scheduler_options=capacity)
+        try:
+            with pytest.raises(SchedulerRejected):
+                for i in range(24):
+                    single.submit(parse_request(_payload(seed=100 + i)))
+        finally:
+            single.shutdown(drain=False, timeout=10.0)
+
+        registry = Registry()
+        router = _fleet(3, registry=registry, delay_s=0.03,
+                        scheduler_options=capacity)
+        payloads = [_payload(seed=100 + i) for i in range(24)]
+        expected = {p["request_id"]: _serial_statement(p) for p in payloads}
+        try:
+            requests = [parse_request(p) for p in payloads]
+            doomed = router.route_for(requests[0])
+            tickets = [router.submit(req) for req in requests]  # none reject
+            threads = _wait_all(tickets)
+            # Kill the replica serving request 0 while it has work in
+            # flight (its backend is slow, so the first dispatch is still
+            # sleeping); its requests MUST fail over, not fail.
+            deadline = time.monotonic() + 10.0
+            while (doomed.scheduler.stats()["inflight"] == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            router.kill_replica(doomed.name)
+            for thread in threads:
+                thread.join(timeout=60.0)
+
+            results = [t.result() for t in tickets]  # raises on any failure
+            assert all(t.outcome == "ok" for t in tickets)
+            for req, result in zip(requests, results):
+                assert result["statement"] == expected[req.request_id]
+                assert result["served_by"] != doomed.name
+                assert result["served_tier"] == "full"
+            assert router.failovers_total > 0
+            stats = router.stats()["fleet"]
+            assert stats["lost"] == 1
+            assert stats["failovers_total"] == router.failovers_total
+            metrics = registry.to_prometheus()
+            assert "fleet_failovers_total" in metrics
+            assert "fleet_replicas_lost 1" in metrics
+        finally:
+            router.shutdown(drain=False, timeout=10.0)
+
+    def test_failed_over_request_is_requeued_not_rerejected(self):
+        # Survivor queues full at failover time: the fleet-admitted
+        # request retries under its deadline instead of surfacing a 429.
+        registry = Registry()
+        router = _fleet(
+            2, registry=registry, delay_s=0.05,
+            scheduler_options={"max_inflight": 1, "max_queue_depth": 2},
+        )
+        try:
+            requests = [parse_request(_payload(seed=300 + i))
+                        for i in range(4)]
+            doomed = router.route_for(requests[0])
+            tickets = [router.submit(req) for req in requests]
+            threads = _wait_all(tickets)
+            deadline = time.monotonic() + 10.0
+            while (doomed.scheduler.stats()["inflight"] == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            router.kill_replica(doomed.name)
+            for thread in threads:
+                thread.join(timeout=60.0)
+            for ticket in tickets:
+                assert ticket.outcome == "ok", ticket._error
+        finally:
+            router.shutdown(drain=False, timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# tier routing + hedging
+# ---------------------------------------------------------------------------
+
+
+class TestTierRouting:
+    def test_pressure_routes_to_small_tier_and_stamps_degraded(self):
+        router = _fleet(
+            2, delay_s=0.0, tiers=["full", "small"],
+            tier_enter_pressure=0.0,  # any pressure escalates immediately
+            tier_min_dwell_s=0.0,
+        )
+        try:
+            ticket = router.submit(parse_request(_payload(seed=5)))
+            assert ticket.wait(30.0)
+            result = ticket.result()
+            assert result["served_tier"] == "small"
+            assert result["served_by"] == "r1"
+            assert result["degraded"] is True
+            assert result["degraded_reason"] == "tier_routed"
+            assert router.stats()["fleet"]["serving_tier"] == "small"
+        finally:
+            router.shutdown(drain=False, timeout=5.0)
+
+    def test_default_tier_never_stamps_degraded(self):
+        router = _fleet(2, delay_s=0.0, tiers=["full", "small"])
+        try:
+            ticket = router.submit(parse_request(_payload(seed=5)))
+            assert ticket.wait(30.0)
+            result = ticket.result()
+            assert result["served_tier"] == "full"
+            assert not result.get("degraded", False)
+        finally:
+            router.shutdown(drain=False, timeout=5.0)
+
+
+class TestHedging:
+    def test_tail_slow_primary_is_hedged_byte_identical(self):
+        payload = _payload(seed=9)
+        request = parse_request(payload)
+        # Make whichever replica rendezvous picks for this scenario the
+        # slow one, so the hedge fires and the fast copy wins.
+        probe_names = ["r0", "r1"]
+        winner = max(
+            probe_names,
+            key=lambda n: _rendezvous_weight(request.issue, n))
+        backends = [
+            SlowBackend(2.0) if f"r{i}" == winner else SlowBackend(0.0)
+            for i in range(2)
+        ]
+        registry = Registry()
+        router = _fleet(2, registry=registry, backends=backends,
+                        hedge_after_s=0.05)
+        try:
+            ticket = router.submit(request)
+            assert router.route_for(request).name == winner
+            assert ticket.wait(20.0)
+            result = ticket.result()
+            assert result["served_by"] != winner  # the hedge won
+            assert result["statement"] == _serial_statement(payload)
+            assert ticket.hedged and router.hedges_total >= 1
+            assert "fleet_hedges_total 1" in registry.to_prometheus()
+        finally:
+            router.shutdown(drain=False, timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface + loadgen integration
+# ---------------------------------------------------------------------------
+
+
+def _post(base_url, payload, timeout=30.0):
+    request = urllib.request.Request(
+        base_url + "/v1/consensus",
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=timeout) as response:
+            return response.status, json.loads(response.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+class TestFleetHTTP:
+    def test_healthz_aggregates_and_degrades_on_replica_loss(self):
+        registry = Registry()
+        server = create_server(
+            backend="fake", port=0, registry=registry, fleet_size=3,
+            max_inflight=2, max_queue_depth=8,
+        ).start()
+        try:
+            status, body = _post(server.base_url, _payload(seed=11))
+            assert status == 200 and body["served_by"]
+
+            with urllib.request.urlopen(
+                    server.base_url + "/healthz", timeout=5) as response:
+                health = json.loads(response.read().decode())
+            assert health["status"] == "ok"
+            fleet = health["fleet"]
+            assert fleet["size"] == 3 and fleet["healthy"] == 3
+            assert fleet["availability"] == 1.0
+            assert set(fleet["replicas"]) == {"r0", "r1", "r2"}
+            for snap in fleet["replicas"].values():
+                assert snap["tier"] == "full"
+                assert snap["health"] == "healthy"
+                assert "circuit_breaker" in snap
+
+            server.scheduler.kill_replica("r0")
+            with urllib.request.urlopen(
+                    server.base_url + "/healthz", timeout=5) as response:
+                health = json.loads(response.read().decode())
+            assert health["status"] == "degraded"
+            assert health["fleet"]["lost"] == 1
+            assert health["fleet"]["replicas"]["r0"]["health"] == "lost"
+
+            metrics = urllib.request.urlopen(
+                server.base_url + "/metrics", timeout=5).read().decode()
+            assert "fleet_replicas_healthy 2" in metrics
+            assert "fleet_replicas_lost 1" in metrics
+            assert "fleet_routed_total" in metrics
+        finally:
+            server.stop(timeout=10.0)
+
+    def test_loadgen_reports_fleet_surface(self):
+        from consensus_tpu.serve.loadgen import run_loadgen
+
+        server = create_server(
+            backend="fake", port=0, registry=Registry(), fleet_size=2,
+            max_inflight=2, max_queue_depth=16,
+        ).start()
+        try:
+            payloads = [_payload(seed=400 + i) for i in range(8)]
+            report = run_loadgen(server.base_url, payloads, rate_rps=50.0)
+        finally:
+            server.stop(timeout=10.0)
+        assert report["availability"] == 1.0
+        assert report["fleet"]["size"] == 2
+        assert sum(report["replica_request_counts"].values()) == 8
+        assert report["failover_fraction"] == 0.0

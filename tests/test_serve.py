@@ -709,3 +709,32 @@ class TestDegradationSurface:
                 ticket.result()
         finally:
             scheduler.shutdown(drain=True, timeout=10.0)
+
+
+class TestKvOomRejection:
+    def test_oversized_request_maps_to_http_413(self):
+        """SchedulerRejected(kv_oom) from the engine's page-pool admission
+        surfaces as 413 (the REQUEST is too large — retrying unchanged can
+        never succeed), distinct from the 429 queue_full overload path,
+        and is counted under serve_rejected_total{reason="kv_oom"}."""
+        registry = Registry()
+        server = create_server(
+            backend=FakeBackend(), port=0, registry=registry,
+            engine=True,
+            engine_options={"slots": 2, "page_size": 4, "num_pages": 2},
+        ).start()
+        try:
+            status, body = _post(server.base_url, {
+                "issue": ISSUE,
+                "agent_opinions": OPINIONS,
+                "method": "best_of_n",
+                "params": {"n": 2, "max_tokens": 256},
+                "seed": 3,
+            })
+        finally:
+            server.stop()
+        assert status == 413
+        assert body["error"]["type"] == "rejected"
+        assert body["error"]["reason"] == "kv_oom"
+        assert 'serve_rejected_total{reason="kv_oom"} 1' in \
+            registry.to_prometheus()
