@@ -476,6 +476,150 @@ def main() -> None:
             "fleet_offered_rate_rps": fleet_rate,
         }
 
+    # ---- prefix cache cell: repeated-scenario load, cache on vs off ---
+    # The SAME open-loop workload twice through the decode engine — with
+    # the cross-request prefix KV cache on, then off — against a
+    # repeated-scenario mix (the --scenario-repeat shape production
+    # consensus traffic has).  The honest prefill-work series is
+    # engine_prefill_tokens_total: tokens chunked prefill actually
+    # ingested (prefix-cache hits skip theirs), so the on/off ratio IS
+    # the prefill-FLOPs reduction at any fixed model.  Acceptance
+    # (ROADMAP): >=5x prefill work per statement on repeated-scenario
+    # load, statements byte-identical either way.  Skipped prefill is
+    # never credited as useful work — mfu_accounting stays useful-token-
+    # only.  Also times speculative rollout verification on the real
+    # backend: rollout_many plain vs speculative over the same paths
+    # (identical token streams), reporting wall speedup and draft
+    # acceptance.  BENCH_PREFIX=0 skips; BENCH_PREFIX_MIX reshapes the
+    # scenario mix; BENCH_PREFIX_SPEC=0 skips the rollout sub-cell.
+    prefix_extra = {}
+    if os.environ.get("BENCH_PREFIX", "1") != "0":
+        from consensus_tpu.obs.metrics import Registry
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+        from consensus_tpu.utils.mfu import param_count as _param_count
+
+        prefix_requests = int(os.environ.get("BENCH_PREFIX_REQUESTS", "24"))
+        prefix_rate = float(os.environ.get("BENCH_PREFIX_RATE", "100"))
+        prefix_mix = os.environ.get("BENCH_PREFIX_MIX", "fixed:2")
+        prefix_payloads = scenario_requests(
+            prefix_requests, params={"n": 8, "max_tokens": NEW_TOKENS},
+            scenario_repeat=prefix_mix,
+        )
+
+        def prefix_run(enabled: bool):
+            reg = Registry()
+            engine_options = {"slots": 4, "num_pages": 1024}
+            if enabled:
+                engine_options["prefix_cache"] = True
+            server = create_server(
+                backend="fake", port=0, max_inflight=4,
+                engine=True, engine_options=engine_options, registry=reg,
+            ).start()
+            try:
+                report = run_loadgen(
+                    server.base_url, prefix_payloads, rate_rps=prefix_rate)
+            finally:
+                server.stop()
+            fam = reg.snapshot()["families"].get(
+                "engine_prefill_tokens_total") or {}
+            prefill_tokens = sum(
+                s.get("value", 0) for s in fam.get("series", []))
+            return report, prefill_tokens
+
+        on_report, on_prefill = prefix_run(True)
+        off_report, off_prefill = prefix_run(False)
+        prefix_n_params = _param_count(backend.config)
+        prefix_extra = {
+            "prefix_requests": prefix_requests,
+            "prefix_scenario_mix": prefix_mix,
+            "prefix_availability": on_report["availability"],
+            "prefix_hit_fraction": on_report.get("prefix_hit_fraction"),
+            "prefix_tokens_saved": on_report.get(
+                "prefix_cache", {}).get("tokens_saved"),
+            "prefill_tokens_per_statement": {
+                "cache_off": round(off_prefill / prefix_requests, 1),
+                "cache_on": round(on_prefill / prefix_requests, 1),
+            },
+            "prefill_flops_per_statement": {
+                "cache_off": round(
+                    2 * prefix_n_params * off_prefill / prefix_requests),
+                "cache_on": round(
+                    2 * prefix_n_params * on_prefill / prefix_requests),
+                "note": "2*params*prefill_tokens at the headline model "
+                        "size; the serve cell runs the fake backend, so "
+                        "the on/off RATIO is the measurement",
+            },
+            "prefill_work_reduction_x": round(
+                off_prefill / max(on_prefill, 1), 2),
+            "prefix_statements_byte_identical": (
+                {o.request_id: o.statement for o in on_report["outcomes"]}
+                == {o.request_id: o.statement for o in off_report["outcomes"]}
+            ),
+            "prefix_goal": ">=5x prefill work per statement on "
+                           "repeated-scenario load, byte-identical "
+                           "statements",
+        }
+
+        if os.environ.get("BENCH_PREFIX_SPEC", "1") != "0":
+            from consensus_tpu.backends.session import SearchSpec
+            from consensus_tpu.backends.tpu import TPUTokenSearchSession
+
+            spec_depth = int(os.environ.get("BENCH_SPEC_DEPTH", "10"))
+            agent_prompts = tuple(
+                ("You judge consensus statements for one participant.",
+                 f"Opinion: {op}\nStatement:")
+                for op in opinions.values()
+            )
+
+            def rollout_wall(speculative: bool):
+                sess = TPUTokenSearchSession(backend, SearchSpec(
+                    ref_system="You draft consensus statements.",
+                    ref_user=f"Issue: {issue}\nStatement:",
+                    agent_prompts=agent_prompts,
+                    n_slots=1, k=4, temperature=1.0, seed=17, sample=False,
+                    max_steps=spec_depth + 2, speculative=speculative,
+                ))
+                try:
+                    root = sess.propose()[0]
+                    suffixes = [[c] for c in root] + [[root[0], root[1]]]
+                    salts = list(range(1, len(suffixes) + 1))
+                    sess.rollout_many(suffixes, spec_depth, salts)  # warmup
+                    start = time.perf_counter()
+                    results = sess.rollout_many(suffixes, spec_depth, salts)
+                    wall = time.perf_counter() - start
+                finally:
+                    sess.close()
+                return wall, [r[0] for r in results]
+
+            plain_wall, plain_ids = rollout_wall(False)
+            spec_before = get_registry().snapshot()
+            spec_wall, spec_ids = rollout_wall(True)
+            spec_delta = diff_snapshots(spec_before, get_registry().snapshot())
+
+            def _spec_total(name: str) -> float:
+                family = (spec_delta.get("families") or {}).get(name) or {}
+                return sum(
+                    s.get("value", 0) for s in family.get("series", []))
+
+            spec_proposed = _spec_total("spec_draft_proposed_tokens_total")
+            spec_verified = _spec_total("spec_draft_verified_tokens_total")
+            prefix_extra.update({
+                "spec_rollout_speedup": round(plain_wall / spec_wall, 2)
+                    if spec_wall else None,
+                "spec_rollout_depth": spec_depth,
+                "spec_rollout_plain_wall_s": round(plain_wall, 3),
+                "spec_rollout_spec_wall_s": round(spec_wall, 3),
+                "spec_draft_acceptance": round(
+                    spec_verified / spec_proposed, 4) if spec_proposed else 0.0,
+                "spec_token_streams_identical": plain_ids == spec_ids,
+                "spec_note": "speedup needs accepted drafts, which need "
+                             "self-similar rollout text — with the repo's "
+                             "random weights acceptance is ~0 and speedup "
+                             "<1 is expected; the equivalence (identical "
+                             "streams) is the part pinned in CI",
+            })
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -561,7 +705,9 @@ def main() -> None:
                         f"{V5E_BF16_PEAK_TFLOPS} TFLOP/s (v5e bf16); "
                         "counts USEFUL tokens only — bucket padding, "
                         "KV/weight HBM traffic, and host/RTT overheads all "
-                        "show up as lost MFU, which is the point"
+                        "show up as lost MFU, which is the point; "
+                        "prefix-cache-skipped prefill tokens are never "
+                        "credited as useful work"
                     ),
                     "bon_latency_seconds_per_statement": round(bon_latency_s, 2),
                     "bon_latency_statements_per_sec": round(1.0 / bon_latency_s, 4),
@@ -585,6 +731,7 @@ def main() -> None:
                     **chaos_extra,
                     **brownout_extra,
                     **fleet_extra,
+                    **prefix_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

@@ -86,8 +86,14 @@ class BatchingBackend:
         registry: Optional[Registry] = None,
         engine: bool = False,
         engine_options: Optional[Dict[str, Any]] = None,
+        prefix_cache: bool = False,
     ):
         self.inner = inner
+        #: Convenience flag: ``prefix_cache=True`` folds into the engine
+        #: options (engine mode only — the flush-snapshot path has no page
+        #: pool to cache into).  An explicit ``engine_options`` key wins.
+        if prefix_cache:
+            engine_options = {"prefix_cache": True, **dict(engine_options or {})}
         self.flush_s = flush_ms / 1000.0
         # obs: queue-wait (enqueue -> dispatch), batch-fill (sessions merged
         # per flush), and flush-reason accounting.  ``registry`` isolates

@@ -67,7 +67,7 @@ from consensus_tpu.obs.metrics import (
     Registry,
     get_registry,
 )
-from consensus_tpu.ops.kv_pages import BlockTable, PagePool
+from consensus_tpu.ops.kv_pages import BlockTable, PagePool, PrefixCache
 
 #: Engine defaults.  ``NUM_PAGES``/``PAGE_SIZE`` give a 16k-token pool —
 #: roomy for CPU/fake runs; real TPU runs size the pool from the backend's
@@ -115,17 +115,25 @@ class _Item:
 
 
 class _Row:
-    __slots__ = ("item", "index", "request", "prompt_tokens")
+    __slots__ = ("item", "index", "request", "prompt_tokens", "prompt_ids")
 
-    def __init__(self, item: _Item, index: int, request, prompt_tokens: int):
+    def __init__(
+        self, item: _Item, index: int, request, prompt_ids: List[Any]
+    ):
         self.item = item
         self.index = index
         self.request = request
-        self.prompt_tokens = prompt_tokens
+        #: Tokenized prompt (ids on real backends, pseudo-tokens on the
+        #: fake one) — page accounting AND the prefix-cache content key.
+        self.prompt_ids = prompt_ids
+        self.prompt_tokens = max(1, len(prompt_ids))
 
 
 class _Slot:
-    __slots__ = ("idx", "row", "table", "prefilled", "state", "reserved")
+    __slots__ = (
+        "idx", "row", "table", "prefilled", "state", "reserved",
+        "cached_tokens",
+    )
 
     def __init__(self, idx: int, row: _Row, reserved: int):
         self.idx = idx
@@ -133,10 +141,13 @@ class _Slot:
         self.table = BlockTable(idx)
         self.prefilled = 0
         self.state = _PREFILL
-        #: Worst-case pages this row may ever need (prompt + max_tokens) —
-        #: held against the pool so a resident row can always decode to
-        #: completion without preemption.
+        #: Worst-case pages this row may ever need (prompt + max_tokens
+        #: minus any cached prefix) — held against the pool so a resident
+        #: row can always decode to completion without preemption.
         self.reserved = reserved
+        #: Prompt tokens adopted from the prefix cache (page-aligned) —
+        #: their prefill chunks are skipped entirely.
+        self.cached_tokens = 0
 
 
 class DecodeEngine:
@@ -154,6 +165,8 @@ class DecodeEngine:
         registry: Optional[Registry] = None,
         cancelled_counter=None,
         auto_start: bool = True,
+        prefix_cache: bool = False,
+        prefix_cache_pages: Optional[int] = None,
     ):
         self.inner = inner
         self.n_slots = max(1, int(slots))
@@ -163,6 +176,27 @@ class DecodeEngine:
                 suggest(page_size) if callable(suggest) else DEFAULT_NUM_PAGES
             )
         self.pool = PagePool(int(num_pages), page_size)
+        #: Cross-request prefix KV reuse (ROADMAP item 3): completed
+        #: prompts donate their page-aligned prefix pages to a
+        #: content-addressed LRU; admission adopts the longest cached
+        #: prefix and skips its prefill chunks entirely.  The budget
+        #: defaults to a quarter of the pool — the share
+        #: ``suggest_kv_page_pool`` already reserves headroom for.
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache:
+            identity_fn = getattr(inner, "kv_cache_identity", None)
+            identity = (
+                identity_fn() if callable(identity_fn)
+                else (getattr(inner, "name", type(inner).__name__),)
+            )
+            budget = (
+                int(prefix_cache_pages)
+                if prefix_cache_pages is not None
+                else max(1, self.pool.num_pages // 4)
+            )
+            self.prefix_cache = PrefixCache(
+                self.pool, budget, identity=identity
+            )
         self.prefill_chunk = max(1, int(prefill_chunk))
         #: Decode dispatch heuristic: with prefills still in progress, hold
         #: the cohort until at least this many slots are ready — avoids
@@ -203,6 +237,32 @@ class DecodeEngine:
         self._m_prefill_chunks = reg.counter(
             "engine_prefill_chunks_total",
             "Prompt chunks ingested by interleaved chunked prefill.",
+        )
+        self._m_prefill_tokens = reg.counter(
+            "engine_prefill_tokens_total",
+            "Prompt tokens actually ingested by chunked prefill "
+            "(prefix-cache hits skip theirs, so this is the honest "
+            "prefill-work series).",
+        )
+        self._m_prefix_hits = reg.counter(
+            "prefix_cache_hits_total",
+            "Admissions that adopted a cached page-aligned prompt prefix.",
+        )
+        self._m_prefix_misses = reg.counter(
+            "prefix_cache_misses_total",
+            "Admissions that found no cached prefix.",
+        )
+        self._m_prefix_evictions = reg.counter(
+            "prefix_cache_evictions_total",
+            "Prefix-cache entries evicted by the LRU page budget.",
+        )
+        self._m_prefix_inserted = reg.counter(
+            "prefix_cache_inserted_pages_total",
+            "KV pages donated to the prefix cache by retiring prompts.",
+        )
+        self._m_prefix_saved = reg.counter(
+            "prefix_tokens_saved_total",
+            "Prompt tokens whose prefill was skipped via a cached prefix.",
         )
         #: Queued-call cancellations share the batching adapter's counter
         #: family so PR 1 dashboards keep one cancellation series.
@@ -254,7 +314,7 @@ class DecodeEngine:
             if kind == "generate":
                 for i, req in enumerate(item.requests):
                     self._gen_backlog.append(
-                        _Row(item, i, req, self._count_tokens_for(req))
+                        _Row(item, i, req, self._prompt_token_ids(req))
                     )
             else:
                 self._other[kind].append(item)
@@ -312,6 +372,11 @@ class DecodeEngine:
                 "fused_search_sessions": self._search_sessions,
                 "fused_search_slots": self._search_slots,
                 "backend_lost": self.backend_lost,
+                "prefix_cache": (
+                    {"enabled": True, **self.prefix_cache.stats()}
+                    if self.prefix_cache is not None
+                    else {"enabled": False}
+                ),
             }
 
     # -- loop --------------------------------------------------------------
@@ -444,9 +509,28 @@ class DecodeEngine:
                 # wait for resident rows to retire.
                 break
             self._gen_backlog.pop(0)
-            slot = _Slot(free.pop(0), row, reserved=needed)
+            cached_pages: List[int] = []
+            cached_tokens = 0
+            if self.prefix_cache is not None:
+                cached_pages, cached_tokens = self.prefix_cache.lookup(
+                    row.prompt_ids
+                )
+                if cached_tokens:
+                    self._m_prefix_hits.inc()
+                    self._m_prefix_saved.inc(cached_tokens)
+                else:
+                    self._m_prefix_misses.inc()
+            # Shared pages come off the cache, not the free list — only the
+            # private remainder counts against the reservation.
+            slot = _Slot(free.pop(0), row, reserved=needed - len(cached_pages))
+            if cached_tokens:
+                slot.table.adopt_shared(self.pool, cached_pages, cached_tokens)
+                slot.prefilled = cached_tokens
+                slot.cached_tokens = cached_tokens
+                if slot.prefilled >= row.prompt_tokens:
+                    slot.state = _READY
             self._slots[slot.idx] = slot
-            self._reserved_pages += needed
+            self._reserved_pages += slot.reserved
             self._m_admitted.inc()
 
     def _advance_prefill(self) -> None:
@@ -460,6 +544,7 @@ class DecodeEngine:
                 slot.table.append_tokens(self.pool, chunk)
                 slot.prefilled += chunk
                 self._m_prefill_chunks.inc()
+                self._m_prefill_tokens.inc(chunk)
             if slot.prefilled >= slot.row.prompt_tokens:
                 slot.state = _READY
 
@@ -568,6 +653,23 @@ class DecodeEngine:
     # -- bookkeeping (lock held) --------------------------------------------
 
     def _retire(self, slot: _Slot) -> None:
+        if self.prefix_cache is not None and slot.prefilled >= slot.row.prompt_tokens:
+            # Donate the fully-prefilled, page-aligned prompt prefix before
+            # releasing: the cache takes its own reference, so the pages
+            # survive this slot's free below.  (Evicted mid-prefill slots
+            # hold partial KV — never cacheable.)
+            ps = self.pool.page_size
+            n_pages = slot.row.prompt_tokens // ps
+            if n_pages > 0:
+                before = self.prefix_cache.evictions
+                if self.prefix_cache.insert(
+                    slot.row.prompt_ids[: n_pages * ps],
+                    slot.table.pages[:n_pages],
+                ):
+                    self._m_prefix_inserted.inc(n_pages)
+                self._m_prefix_evictions.inc(
+                    self.prefix_cache.evictions - before
+                )
         slot.table.release(self.pool)
         self._reserved_pages -= slot.reserved
         self._slots[slot.idx] = None
@@ -635,24 +737,28 @@ class DecodeEngine:
 
     # -- token accounting ----------------------------------------------------
 
-    def _count_tokens_for(self, request) -> int:
+    def _prompt_token_ids(self, request) -> List[Any]:
         parts = [
             getattr(request, "system_prompt", None) or "",
             getattr(request, "user_prompt", "") or "",
         ]
-        return max(1, self._count_text_tokens(" ".join(p for p in parts if p)))
+        return self._tokenize_text(" ".join(p for p in parts if p))
 
     def _count_text_tokens(self, text: str) -> int:
-        """Token count for PAGE accounting only — never for numerics.  Uses
-        the inner backend's real tokenizer when it has one; the fake
-        backend's whitespace pseudo-tokenizer otherwise."""
+        return len(self._tokenize_text(text))
+
+    def _tokenize_text(self, text: str) -> List[Any]:
+        """Tokens for PAGE accounting and prefix-cache CONTENT KEYS only —
+        never for numerics.  Uses the inner backend's real tokenizer when
+        it has one; the fake backend's whitespace pseudo-tokenizer
+        otherwise."""
         tok = getattr(self.inner, "tokenizer", None)
         if tok is not None and hasattr(tok, "encode"):
             try:
-                return len(tok.encode(text))
+                return list(tok.encode(text))
             except Exception:
                 pass
         pseudo = getattr(self.inner, "_tokenize", None)
         if callable(pseudo):
-            return len(pseudo(text))
-        return len(text.split())
+            return list(pseudo(text))
+        return text.split()

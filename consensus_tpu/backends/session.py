@@ -64,6 +64,16 @@ class SearchSpec:
     bias_value: float = BAN_BIAS
     max_steps: int = 64
     failure_logprob: float = -10.0  # substituted when a backend scores nothing
+    #: Speculative rollout verification (Leviathan et al.): an n-gram
+    #: self-draft proposer emits ``spec_draft_len`` suffix tokens per leaf
+    #: and the target model verifies the whole draft in one parallel
+    #: forward (models/stepper.rollout_verify_many), with standard
+    #: rejection keeping token streams byte-identical to the sequential
+    #: scan.  TPU fused sessions only — the full-prefix fallback's rollout
+    #: is already ONE batched generate call, so speculation is accepted
+    #: and ignored there (trivially byte-identical).
+    speculative: bool = False
+    spec_draft_len: int = 8
 
 
 class PrefixTokenSearchSession:

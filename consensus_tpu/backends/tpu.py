@@ -400,13 +400,24 @@ class TPUBackend:
 
     # -- helpers -------------------------------------------------------------
 
+    def kv_cache_identity(self) -> tuple:
+        """Content-key identity for cross-request prefix KV reuse: two
+        backends may share cached prefix pages only when the model tier AND
+        the KV quantization mode match — the engine's PrefixCache folds
+        this into every blake2b content key (ops/kv_pages.py)."""
+        return (self.model_name, "int8" if self.kv_quant else "dense")
+
     def suggest_kv_page_pool(self, page_size: int = 16) -> int:
         """Size the decode engine's KV page pool from the session HBM
         budget (backends/engine.py asks at construction).  One page holds
         ``page_size`` tokens of per-layer K+V; ``kv_quant`` halves the
         bytes (int8 + per-token scale ≈ half of bf16).  Half the session
         budget goes to pages — the rest stays for fused search sessions,
-        which reserve through ``_SessionBudget`` as before."""
+        which reserve through ``_SessionBudget`` as before.  The pool's
+        page count INCLUDES the prefix cache's share: the engine's LRU
+        budget (a quarter of the pool by default) bounds how many of these
+        pages cached prefixes may pin, so cache + resident slots can never
+        outgrow the reservation made here."""
         c = self.config
         kv_itemsize = (
             1.25
@@ -1509,6 +1520,30 @@ class TPUTokenSearchSession:
         else:
             self._base_key = backend._fold_seed("search", spec.seed)
         self._temperature = jnp.asarray(spec.temperature, jnp.float32)
+        #: Speculative rollout verification (backends/speculative.py +
+        #: models/stepper.rollout_verify_many): an n-gram self-draft
+        #: proposer seeded from the reference prompt + trunk advances.
+        self._proposer = None
+        if getattr(spec, "speculative", False):
+            from consensus_tpu.backends.speculative import NGramProposer
+
+            self._proposer = NGramProposer()
+            self._proposer.observe(token_lists[0])
+            #: Trunk token ids (ref-role prompt + advances) — the drafting
+            #: context every rollout continues from.
+            self._trunk_ids = list(token_lists[0])
+            reg = backend.instruments.registry
+            label = backend.name
+            self._obs_spec_proposed = reg.counter(
+                "spec_draft_proposed_tokens_total",
+                "Draft tokens proposed for speculative rollout verification",
+                ("backend",),
+            ).labels(label)
+            self._obs_spec_verified = reg.counter(
+                "spec_draft_verified_tokens_total",
+                "Draft tokens accepted by the parallel verify pass",
+                ("backend",),
+            ).labels(label)
 
     # -- protocol ------------------------------------------------------------
 
@@ -1561,6 +1596,9 @@ class TPUTokenSearchSession:
             ]
         )
         step_meta = np.asarray([self._step, self._step - 1], np.int32)
+        if self._proposer is not None:
+            self._proposer.observe([c.token_id for c in chosen])
+            self._trunk_ids.extend(c.token_id for c in chosen)
         self.dispatch_count += 1
         out = search_step(
             self.backend.params, self.backend.config,
@@ -1705,6 +1743,8 @@ class TPUTokenSearchSession:
             return []
         if any(not s for s in suffixes):
             raise ValueError("rollout_many needs non-empty suffixes")
+        if self._proposer is not None:
+            return self._rollout_many_spec(suffixes, depth, salts)
         groups: Dict[int, List[int]] = {}
         for i, suffix in enumerate(suffixes):
             groups.setdefault(len(suffix), []).append(i)
@@ -1746,6 +1786,116 @@ class TPUTokenSearchSession:
                 )  # (n_paths, depth, 2 + A)
                 for j, i in enumerate(chunk):
                     results[i] = self._rollout_result(rows[j], depth)
+        return results
+
+    def _rollout_many_spec(
+        self, suffixes: Sequence[Sequence], depth: int, salts: Sequence[int]
+    ) -> List[Tuple[List[int], str, List[float], bool]]:
+        """Speculative rollout_many: draft each path's whole remaining
+        rollout from the n-gram proposer and verify it in ONE parallel
+        ``rollout_verify_many`` forward per round (all active paths ride
+        the same dispatch).  Each round accepts every path's longest
+        draft-matched prefix plus the first corrected token — standard
+        rejection, so accepted token streams replay the sequential scan
+        exactly, with agent totals agreeing to float tolerance (pinned in
+        tests/test_speculative.py) — and a perfect draft finishes a
+        depth-``d`` rollout in one round instead of ``d`` sequential
+        decode steps."""
+        from consensus_tpu.models.stepper import rollout_verify_many
+
+        spec = self.spec
+        results: List[Optional[Tuple[List[int], str, List[float], bool]]] = (
+            [None] * len(suffixes)
+        )
+        groups: Dict[int, List[int]] = {}
+        for i, suffix in enumerate(suffixes):
+            groups.setdefault(len(suffix), []).append(i)
+        n_agents = self.n_roles - 1
+        for span, idxs in groups.items():
+            cap = max(1, self._rollout_chunk_cap(span, depth))
+            for lo in range(0, len(idxs), cap):
+                chunk = idxs[lo : lo + cap]
+                #: Per path: accepted rows [(token, counted, lps...)], and
+                #: whether an EOS ended the counted stream.
+                emitted: Dict[int, List[List[float]]] = {i: [] for i in chunk}
+                finished: Dict[int, bool] = {i: False for i in chunk}
+                contexts = {
+                    i: self._trunk_ids + [c.token_id for c in suffixes[i]]
+                    for i in chunk
+                }
+                while True:
+                    active = [
+                        i for i in chunk
+                        if not finished[i] and len(emitted[i]) < depth
+                    ]
+                    if not active:
+                        break
+                    drafts: Dict[int, List[int]] = {}
+                    for i in active:
+                        accepted = [int(r[0]) for r in emitted[i]]
+                        fresh = self._proposer.draft(
+                            contexts[i] + accepted, depth - len(accepted)
+                        )
+                        self._obs_spec_proposed.inc(len(fresh))
+                        drafts[i] = accepted + fresh
+                    n_paths = _bucket(len(active), minimum=2)
+                    tokens = np.zeros((n_paths, span), np.int32)
+                    draft_arr = np.zeros((n_paths, depth), np.int32)
+                    salt_arr = np.zeros((n_paths,), np.int32)
+                    for j, i in enumerate(active):
+                        tokens[j] = [c.token_id for c in suffixes[i]]
+                        draft_arr[j] = drafts[i]
+                        salt_arr[j] = salts[i]
+                    tokens[len(active):] = tokens[0]
+                    draft_arr[len(active):] = draft_arr[0]
+                    salt_arr[len(active):] = salt_arr[0]
+                    self.dispatch_count += 1
+                    rows = np.asarray(
+                        rollout_verify_many(
+                            self.backend.params, self.backend.config,
+                            self._state, jnp.asarray(self._step, jnp.int32),
+                            jnp.asarray(tokens), jnp.asarray(draft_arr),
+                            jnp.asarray(salt_arr),
+                            self.n_roles, span, depth,
+                            self._base_key, self._temperature,
+                            jnp.asarray(
+                                self.backend.tokenizer.eos_ids, jnp.int32
+                            ),
+                        )
+                    )  # (n_paths, depth, 2 + A)
+                    for j, i in enumerate(active):
+                        t = len(emitted[i])
+                        while t < depth:
+                            chosen = int(rows[j, t, 0])
+                            is_eos = rows[j, t, 1] > 0.5
+                            counted = 0.0 if is_eos else 1.0
+                            emitted[i].append(
+                                [float(chosen), counted]
+                                + [
+                                    float(v) * counted
+                                    for v in rows[j, t, 2:]
+                                ]
+                            )
+                            matched = chosen == int(drafts[i][t])
+                            if matched:
+                                self._obs_spec_verified.inc()
+                            t += 1
+                            if is_eos:
+                                # Post-EOS tokens are uncounted in the
+                                # sequential scan and filtered from the
+                                # result — stop generating them at all.
+                                finished[i] = True
+                                break
+                            if not matched:
+                                # chosen is the valid correction; rows past
+                                # it were conditioned on the wrong draft.
+                                break
+                for i in chunk:
+                    out = np.zeros((depth, 2 + n_agents), np.float32)
+                    if emitted[i]:
+                        got = np.asarray(emitted[i], np.float32)
+                        out[: got.shape[0]] = got
+                    results[i] = self._rollout_result(out, depth)
         return results
 
     def _rollout_chunk_cap(self, span: int, depth: int) -> int:

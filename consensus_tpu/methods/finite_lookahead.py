@@ -72,6 +72,13 @@ class FiniteLookaheadGenerator(BaseGenerator):
         max_tokens = int(cfg.get("max_tokens", 50))
         temperature = float(cfg.get("temperature", 1.0))
         seed = self.seed
+        # Optional leaf value-estimate rollouts (default 0 = off, semantics
+        # unchanged): each surviving frontier leaf continues
+        # ``rollout_depth`` reference-policy tokens in ONE batched
+        # rollout_many call per emitted token, and ranking scores the mean
+        # logprob over path + rollout — a longer horizon at one extra
+        # dispatch.  This is the call speculative verification accelerates.
+        rollout_depth = max(0, int(cfg.get("rollout_depth", 0)))
         # Timing mode (experiment timing_pin_budget): no terminator may end
         # the statement or a path early — the tree runs its full budget.
         terminators = (
@@ -105,6 +112,10 @@ class FiniteLookaheadGenerator(BaseGenerator):
                 bias_against_tokens=BIAS_AGAINST_TOKENS,
                 max_steps=max_tokens,
                 failure_logprob=DEFAULT_FAILURE_REWARD,
+                speculative=bool(cfg.get("speculative_rollouts", False)),
+                spec_draft_len=int(
+                    cfg.get("spec_draft_len", rollout_depth or 8)
+                ),
             ),
         )
 
@@ -115,7 +126,7 @@ class FiniteLookaheadGenerator(BaseGenerator):
             for step in range(max_tokens):
                 best = self._best_path(
                     session, root_proposals, branching, max_depth, step,
-                    terminators, clock=clock,
+                    terminators, clock=clock, rollout_depth=rollout_depth,
                 )
                 if best is None:
                     break
@@ -168,14 +179,21 @@ class FiniteLookaheadGenerator(BaseGenerator):
         session, root_proposals: List[ScoredCandidate], branching: int,
         max_depth: int, step: int,
         terminators: frozenset = TERMINATOR_TOKENS,
-        clock=None,
+        clock=None, rollout_depth: int = 0,
     ):
         """Grow the level-batched tree from the trunk, accumulate per-agent
         logprob sums along every path, and return the max-min mean path
         (reference :424-536).  A level is one device dispatch, so the
         anytime ``clock`` is checked between levels: on expiry the tree
         stops growing and the best path over the partial tree is returned —
-        every partial tree still ranks complete root-to-leaf prefixes."""
+        every partial tree still ranks complete root-to-leaf prefixes.
+
+        With ``rollout_depth > 0`` every surviving (non-terminated, deduped)
+        leaf additionally continues ``rollout_depth`` reference-policy
+        tokens in ONE batched ``rollout_many`` dispatch, and its welfare
+        becomes the max-min MEAN logprob over path + rollout — the same
+        egalitarian statistic over a longer horizon.  Terminated paths keep
+        the plain path mean (rolling out past a terminator is meaningless)."""
         frontier: List[Path] = []
         finished: List[Path] = []
         for cand in root_proposals[:branching]:
@@ -207,14 +225,56 @@ class FiniteLookaheadGenerator(BaseGenerator):
             frontier = next_frontier
 
         # Dedup by joined token string, drop empties (reference :402-414).
-        best, best_welfare = None, None
+        candidates: List[Tuple[Path, bool]] = []
         seen = set()
-        for path, sums in finished + frontier:
+        for path, sums in finished:
             key = "".join(c.token for c in path)
             if not key or key in seen:
                 continue
             seen.add(key)
-            welfare = min(s / len(path) for s in sums)
+            candidates.append(((path, sums), False))
+        open_leaves: List[Path] = []
+        for path, sums in frontier:
+            key = "".join(c.token for c in path)
+            if not key or key in seen:
+                continue
+            seen.add(key)
+            candidates.append(((path, sums), True))
+            open_leaves.append((path, sums))
+
+        # Leaf value estimates: one batched dispatch for every open leaf.
+        # Salt stride 100003 (prime >> leaves per step) keeps the family-2
+        # rollout seeds disjoint across emitted tokens.
+        rollouts: Dict[int, Tuple[List[float], int]] = {}
+        if (
+            rollout_depth > 0 and open_leaves
+            and not (clock is not None and clock.expired())
+        ):
+            salts = [
+                (step + 1) * 100003 + j for j in range(len(open_leaves))
+            ]
+            for j, (_ids, _text, totals, ok) in enumerate(
+                session.rollout_many(
+                    [path for path, _ in open_leaves], rollout_depth, salts
+                )
+            ):
+                if ok and _ids:
+                    rollouts[j] = (totals, len(_ids))
+
+        best, best_welfare = None, None
+        leaf_index = 0
+        for (path, sums), is_open in candidates:
+            horizon = rollouts.get(leaf_index) if is_open else None
+            if is_open:
+                leaf_index += 1
+            if horizon is not None:
+                totals, n = horizon
+                welfare = min(
+                    (s + r) / (len(path) + n)
+                    for s, r in zip(sums, totals)
+                )
+            else:
+                welfare = min(s / len(path) for s in sums)
             if best_welfare is None or welfare > best_welfare:
                 best_welfare, best = welfare, (path, sums)
         return best

@@ -167,6 +167,14 @@ class MCTSGenerator(BaseGenerator):
                 bias_against_tokens=BIAS_AGAINST_TOKENS,
                 max_steps=max_tokens,
                 failure_logprob=FAILURE_REWARD,
+                # Speculative rollout verification: n-gram drafts verified
+                # in one parallel forward per wave round; byte-identical to
+                # the sequential rollouts by rejection (fused sessions
+                # only; the fallback session accepts and ignores it).
+                speculative=bool(cfg.get("speculative_rollouts", False)),
+                spec_draft_len=int(
+                    cfg.get("spec_draft_len", self._rollout_depth)
+                ),
             ),
         )
         self._salt = 0

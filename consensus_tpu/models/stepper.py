@@ -520,6 +520,99 @@ def rollout_scored_many(
     return jnp.moveaxis(out_rows, 0, 1)  # (P, depth, 2 + A)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "n_roles", "suffix_len", "depth"),
+)
+def rollout_verify_many(
+    params,
+    config: ModelConfig,
+    state: SearchState,  # n_slots=1 trunk session (NOT consumed)
+    t_filled: jax.Array,  # () int32
+    suffix_tokens: jax.Array,  # (P, suffix_len) int32 — one row per path
+    draft_tokens: jax.Array,  # (P, depth) int32 — teacher-forced drafts
+    salts: jax.Array,  # (P,) int32 — SAME salts rollout_scored_many takes
+    n_roles: int,
+    suffix_len: int,
+    depth: int,
+    base_key: jax.Array,  # (2,)
+    temperature: jax.Array,
+    eos_ids: jax.Array,  # (E,) int32
+) -> jax.Array:
+    """Speculative verification of whole rollout drafts in ONE parallel
+    forward (Leviathan et al.: draft cheap, verify wide).  Teacher-forces
+    each path's ``depth``-token draft past trunk+tail+suffix via a single
+    ``forward_shared_trunk`` pass over [suffix ++ draft] and replays the
+    EXACT per-step sampling decisions of :func:`rollout_scored_many`: the
+    choice at rollout step ``t`` reads hidden column ``suffix_len - 1 + t``
+    (conditioned on ``draft[:t]``), folds the same (family-2, salt, t)
+    PRNG key, and applies the same f32 log-softmax + categorical/argmax.
+
+    Returns packed (P, depth, 2 + A) f32 rows
+    [chosen_token, is_eos, agent_logprobs_of_chosen...].  Row ``t`` is
+    valid iff ``draft[:t]`` matches the chosen tokens before it — the host
+    accepts the longest matched prefix plus the first correction (standard
+    rejection), so accepted token STREAMS replay the sequential scan
+    exactly: position ``t`` attends the same trunk/suffix entries in the
+    same order (later draft columns are masked to exactly-zero softmax
+    terms — the argument rollout_many == rollout_from already leans on)
+    and folds the identical PRNG key, so the categorical/argmax decision
+    agrees everywhere the logits aren't ulp-tied.  Agent logprob TOTALS
+    carry float-tolerance wiggle (~1e-6): the one-pass verify projects
+    logits at a different matmul shape than the step-by-step scan, so row
+    reductions tile differently.  Same contract the batched rollout tests
+    already pin (exact ids, allclose totals) — re-pinned for this program
+    on tiny models in tests/test_speculative.py.  The session state is
+    untouched."""
+    n_paths = suffix_tokens.shape[0]
+    scratch, _ = _scratch_cache(state, t_filled, extra=0)
+    ext = jnp.concatenate([suffix_tokens, draft_tokens], axis=1)
+    hidden = forward_shared_trunk(
+        params, config, ext, scratch, state.cur_pos,
+        return_all_positions=True,
+    )  # (P, R, suffix_len + depth, D)
+    h = jax.lax.dynamic_slice_in_dim(hidden, suffix_len - 1, depth, axis=2)
+    logits = project_logits(
+        params, config, h.reshape(n_paths * n_roles * depth, -1)
+    ).reshape(n_paths, n_roles, depth, -1)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    rollout_keys = jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.fold_in(base_key, 2), s)
+    )(salts)  # (P, 2)
+    keys = jax.vmap(
+        lambda kk: jax.vmap(lambda t: jax.random.fold_in(kk, t))(
+            jnp.arange(depth)
+        )
+    )(rollout_keys)  # (P, depth, 2)
+    ref_lp = lp[:, 0, :, :]  # (P, depth, V)
+    sampled = jax.vmap(jax.vmap(jax.random.categorical))(
+        keys, ref_lp / jnp.maximum(temperature, 1e-6)
+    )
+    token = jnp.where(
+        temperature <= 0.0, jnp.argmax(ref_lp, axis=-1), sampled
+    ).astype(jnp.int32)  # (P, depth)
+    is_eos = (
+        jnp.any(token[..., None] == eos_ids[None, None, :], axis=-1)
+        if eos_ids.shape[0]
+        else jnp.zeros((n_paths, depth), bool)
+    )
+    agent_lps = jnp.take_along_axis(
+        lp[:, 1:, :, :],
+        jnp.broadcast_to(
+            token[:, None, :, None], (n_paths, n_roles - 1, depth, 1)
+        ),
+        axis=-1,
+    )[..., 0]  # (P, A, depth)
+    return jnp.concatenate(
+        [
+            token.astype(jnp.float32)[..., None],
+            is_eos.astype(jnp.float32)[..., None],
+            jnp.moveaxis(agent_lps, 1, 2),
+        ],
+        axis=-1,
+    )  # (P, depth, 2 + A)
+
+
 # ---------------------------------------------------------------------------
 # Paged slot programs (continuous-batching engine)
 # ---------------------------------------------------------------------------
@@ -689,6 +782,41 @@ def paged_decode_step(
     hidden, state = _paged_forward(
         params, config, tokens[:, None], positions, state,
         block_tables, lengths, write_pages[:, None], write_offsets[:, None],
+    )
+    logits = project_logits(params, config, hidden[:, 0, :])
+    return logits, state
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config",), donate_argnums=(3,)
+)
+def paged_gather_step(
+    params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (B,) int32 — each slot's LAST cached token
+    state: PagedSlotState,
+    block_tables: jax.Array,  # (B, max_blocks) — may name SHARED pages
+    lengths: jax.Array,  # (B,) int32 — cached stream length
+) -> Tuple[jax.Array, PagedSlotState]:
+    """Read-only decode step over shared prefix pages (the prefix cache's
+    gather path).  When a slot adopts a fully cached prompt it still needs
+    the logits at the last prompt position to start decoding — this
+    re-forwards that one token, gathering K/V through the block table
+    exactly like :func:`paged_decode_step`, but routes the recomputed K/V
+    to the write SINK: pages another slot (or the cache) owns are read in
+    place, never copied and never mutated.  Attention reads the STORED
+    page for the query's own position (the bytes the owner's prefill
+    wrote), so the logits match the owning slot's dense/prefill logits at
+    that position to float tolerance — pinned against the dense forward
+    in tests/test_engine.py.  Returns (logits (B, V) f32, state) — only
+    the sink page changed."""
+    num_pages = state.k_pages.shape[1] - 1
+    b = tokens.shape[0]
+    sink = jnp.full((b, 1), num_pages, jnp.int32)
+    positions = (lengths - 1)[:, None]
+    hidden, state = _paged_forward(
+        params, config, tokens[:, None], positions, state,
+        block_tables, lengths, sink, jnp.zeros((b, 1), jnp.int32),
     )
     logits = project_logits(params, config, hidden[:, 0, :])
     return logits, state

@@ -10,13 +10,18 @@ p50/p95/p99 latency, and the rejection rate.
 Request bodies replay the AAMAS survey scenarios
 (``consensus_tpu/data/aamas_scenarios.py``) round-robin, with distinct
 seeds so the workload is deterministic but not degenerate-identical.
-Stdlib only (``urllib``), like the front end.
+``scenario_repeat`` skews the scenario mix toward repeats (Zipf or a
+fixed-k rotation) — the regime where the engine's prefix KV cache pays —
+and the report then carries ``prefix_hit_fraction`` read from the
+server's /healthz engine stats.  Stdlib only (``urllib``), like the
+front end.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -26,6 +31,41 @@ from typing import Any, Dict, List, Optional
 from consensus_tpu.data.aamas_scenarios import SCENARIOS
 
 
+def _scenario_sequence(
+    count: int, n_scenarios: int, scenario_repeat: Optional[str],
+    base_seed: int,
+) -> List[int]:
+    """Deterministic scenario indices for ``count`` requests.
+
+    ``scenario_repeat`` picks the arrival mix:
+
+    * ``None`` — round-robin over all scenarios (the historical default;
+      every prompt distinct until the rotation wraps).
+    * ``"fixed:K"`` — round-robin over only the first K scenarios, so each
+      prompt repeats every K requests (K=1 is the degenerate all-same
+      stream).
+    * ``"zipf:S"`` — scenario rank r drawn with probability ∝ 1/(r+1)^S
+      (seeded by ``base_seed``): a few hot scenarios dominate, the tail
+      stays cold — the shape real consensus traffic has, and the one the
+      prefix cache's LRU is sized for.
+    """
+    if scenario_repeat is None:
+        return [i % n_scenarios for i in range(count)]
+    kind, _, arg = str(scenario_repeat).partition(":")
+    if kind == "fixed":
+        k = max(1, min(n_scenarios, int(arg or 1)))
+        return [i % k for i in range(count)]
+    if kind == "zipf":
+        s = float(arg or 1.1)
+        weights = [1.0 / (rank + 1) ** s for rank in range(n_scenarios)]
+        rng = random.Random(base_seed)
+        return rng.choices(range(n_scenarios), weights=weights, k=count)
+    raise ValueError(
+        f"scenario_repeat must be None, 'fixed:K', or 'zipf:S', "
+        f"got {scenario_repeat!r}"
+    )
+
+
 def scenario_requests(
     count: int,
     method: str = "best_of_n",
@@ -33,12 +73,15 @@ def scenario_requests(
     base_seed: int = 100,
     evaluate: bool = False,
     timeout_s: Optional[float] = None,
+    scenario_repeat: Optional[str] = None,
 ) -> List[Dict[str, Any]]:
-    """``count`` request payloads cycling the AAMAS scenarios."""
+    """``count`` request payloads cycling the AAMAS scenarios (see
+    :func:`_scenario_sequence` for the ``scenario_repeat`` mixes)."""
     keys = sorted(SCENARIOS)
+    order = _scenario_sequence(count, len(keys), scenario_repeat, base_seed)
     payloads = []
     for i in range(count):
-        scenario = SCENARIOS[keys[i % len(keys)]]
+        scenario = SCENARIOS[keys[order[i]]]
         payload: Dict[str, Any] = {
             "issue": scenario["issue"],
             "agent_opinions": dict(scenario["agent_opinions"]),
@@ -136,6 +179,7 @@ def run_loadgen(
             )
 
     fleet_before = fetch_fleet_stats(base_url)
+    prefix_before = fetch_prefix_stats(base_url)
     threads: List[threading.Thread] = []
     start_wall = time.perf_counter()
     for i, payload in enumerate(payloads):
@@ -223,9 +267,32 @@ def run_loadgen(
             "failovers": failovers,
             "hedges_total": fleet_after.get("hedges_total", 0),
         }
+        report["fleet"]["affinity_hit_rate"] = fleet_after.get(
+            "affinity_hit_rate", 0.0
+        )
         report["replica_request_counts"] = replica_counts
         report["failover_fraction"] = (
             round(failovers / len(ok), 4) if ok else 0.0
+        )
+    prefix_after = fetch_prefix_stats(base_url)
+    if prefix_after is not None:
+        # Prefix-cache effectiveness over THIS run: admission hit/miss
+        # deltas across every engine behind the server (one in single mode,
+        # one per replica in fleet mode).
+        before = prefix_before or {}
+        hits = prefix_after.get("hits", 0) - before.get("hits", 0)
+        misses = prefix_after.get("misses", 0) - before.get("misses", 0)
+        saved = (
+            prefix_after.get("tokens_saved", 0)
+            - before.get("tokens_saved", 0)
+        )
+        report["prefix_cache"] = {
+            "hits": hits,
+            "misses": misses,
+            "tokens_saved": saved,
+        }
+        report["prefix_hit_fraction"] = (
+            round(hits / (hits + misses), 4) if (hits + misses) else 0.0
         )
     return report
 
@@ -242,6 +309,41 @@ def fetch_fleet_stats(base_url: str) -> Optional[Dict[str, Any]]:
         return None
     fleet = health.get("fleet")
     return dict(fleet) if isinstance(fleet, dict) else None
+
+
+def fetch_prefix_stats(base_url: str) -> Optional[Dict[str, float]]:
+    """Summed prefix-cache counters across every engine behind the server's
+    /healthz — the single scheduler's ``engine`` block, or each fleet
+    replica's.  None when no engine runs a prefix cache (or /healthz is
+    down)."""
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/healthz", timeout=5.0
+        ) as response:
+            health = json.loads(response.read().decode("utf-8"))
+    except Exception:
+        return None
+    blocks = []
+    engine = health.get("engine")
+    if isinstance(engine, dict):
+        blocks.append(engine.get("prefix_cache"))
+    fleet = health.get("fleet")
+    if isinstance(fleet, dict):
+        for snap in (fleet.get("replicas") or {}).values():
+            if isinstance(snap, dict) and isinstance(
+                snap.get("engine"), dict
+            ):
+                blocks.append(snap["engine"].get("prefix_cache"))
+    blocks = [
+        b for b in blocks if isinstance(b, dict) and b.get("enabled")
+    ]
+    if not blocks:
+        return None
+    totals: Dict[str, float] = {}
+    for key in ("hits", "misses", "evictions", "inserted_pages",
+                "tokens_saved"):
+        totals[key] = sum(b.get(key, 0) for b in blocks)
+    return totals
 
 
 def fetch_tier_counts(base_url: str) -> Optional[Dict[str, int]]:

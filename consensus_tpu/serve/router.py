@@ -13,7 +13,12 @@ the scheduler/ticket surface) and adds the fleet semantics:
 * **Scenario affinity.**  Rendezvous (highest-random-weight) hashing on
   the request's issue text: requests for the same scenario land on the
   same replica while it is healthy, and ONLY the dead replica's scenarios
-  move when one is lost — groundwork for prefix caching (ROADMAP item 3).
+  move when one is lost.  This is what makes the per-replica prefix KV
+  cache (backends/engine.py) effective under fleet serving: the scenario's
+  cached prompt pages live on its rendezvous-first replica, so the router
+  tracks an ``affinity_hit_rate`` — the fraction of dispatches that landed
+  there (misses are spillover, failover, and hedges: the cold-cache
+  dispatches).
 * **Transparent failover.**  A request whose replica dies mid-flight
   (``BackendLostError``, probe timeout, drain) is re-dispatched to a
   healthy replica under its ORIGINAL deadline.  Results are bit-identical
@@ -38,7 +43,8 @@ the scheduler/ticket surface) and adds the fleet semantics:
 
 Obs families: ``fleet_replicas_{healthy,draining,lost}`` (gauges),
 ``fleet_failovers_total{reason}``, ``fleet_routed_total{replica,tier}``,
-``fleet_hedges_total`` (counters), ``fleet_serving_tier`` (gauge).
+``fleet_hedges_total``, ``fleet_affinity_{hits,misses}_total`` (counters),
+``fleet_serving_tier`` (gauge).
 """
 
 from __future__ import annotations
@@ -288,6 +294,18 @@ class FleetRouter:
         self._m_hedges = reg.counter(
             "fleet_hedges_total",
             "Hedge dispatches issued for tail-latency-critical tickets.")
+        #: Scenario affinity effectiveness: a hit means the request landed
+        #: on its rendezvous-first replica — the one holding the scenario's
+        #: warm prefix-cache entries.  Misses (spillover under backpressure,
+        #: failover, hedges) are exactly the dispatches that start cold.
+        self._m_affinity_hits = reg.counter(
+            "fleet_affinity_hits_total",
+            "Dispatches that landed on the scenario's rendezvous-first "
+            "replica (warm prefix cache).")
+        self._m_affinity_misses = reg.counter(
+            "fleet_affinity_misses_total",
+            "Dispatches that landed off the scenario's rendezvous-first "
+            "replica (spillover, failover, or hedge — cold prefix cache).")
         self._m_tier = reg.gauge(
             "fleet_serving_tier",
             "Current tier-lever index (0 = full-model tier).")
@@ -297,6 +315,8 @@ class FleetRouter:
         self.failover_reasons: Dict[str, int] = {}
         self.hedges_total = 0
         self.routed_counts: Dict[str, int] = {r.name: 0 for r in self.replicas}
+        self.affinity_hits = 0
+        self.affinity_misses = 0
 
         self._draining = False
         self._stop_probe = threading.Event()
@@ -450,7 +470,7 @@ class FleetRouter:
                 last = exc
                 continue
             ticket._attach(inner, replica)
-            self._count_routed(replica)
+            self._count_routed(replica, affinity_hit=replica is candidates[0])
             self._refresh_gauges()
             return ticket
         assert last is not None
@@ -642,12 +662,21 @@ class FleetRouter:
 
     # -- counters / gauges -------------------------------------------------
 
-    def _count_routed(self, replica: Replica) -> None:
+    def _count_routed(self, replica: Replica,
+                      affinity_hit: bool = False) -> None:
         self._m_routed.labels(replica.name, replica.tier).inc()
+        if affinity_hit:
+            self._m_affinity_hits.inc()
+        else:
+            self._m_affinity_misses.inc()
         with self._counts_lock:
             self.routed_counts[replica.name] = (
                 self.routed_counts.get(replica.name, 0) + 1
             )
+            if affinity_hit:
+                self.affinity_hits += 1
+            else:
+                self.affinity_misses += 1
 
     def _count_failover(self, reason: str) -> None:
         self._m_failovers.labels(reason).inc()
@@ -710,6 +739,8 @@ class FleetRouter:
             failovers_total = self.failovers_total
             failover_reasons = dict(self.failover_reasons)
             hedges_total = self.hedges_total
+            affinity_hits = self.affinity_hits
+            affinity_misses = self.affinity_misses
         size = len(self.replicas)
         stats: Dict[str, Any] = dict(totals)
         stats["draining"] = self._draining
@@ -729,6 +760,12 @@ class FleetRouter:
             "failovers_total": failovers_total,
             "failovers": failover_reasons,
             "hedges_total": hedges_total,
+            "affinity_hits": affinity_hits,
+            "affinity_misses": affinity_misses,
+            "affinity_hit_rate": (
+                affinity_hits / (affinity_hits + affinity_misses)
+                if (affinity_hits + affinity_misses) else 0.0
+            ),
             "routed": routed,
             "replicas": replicas,
         }

@@ -42,6 +42,13 @@ def main(argv=None) -> int:
     parser.add_argument("--params", default='{"n": 4, "max_tokens": 24}',
                         help="JSON object of method params")
     parser.add_argument("--seed", type=int, default=100)
+    parser.add_argument("--scenario-repeat", default=None, metavar="MIX",
+                        help="scenario arrival mix: 'fixed:K' cycles the "
+                             "first K scenarios, 'zipf:S' draws ranks with "
+                             "probability 1/(r+1)^S (default: round-robin "
+                             "over all scenarios); repeated scenarios are "
+                             "what the prefix KV cache accelerates, and "
+                             "the report then shows prefix_hit_fraction")
     parser.add_argument("--evaluate", action="store_true",
                         help="request per-agent utilities + welfare too")
     parser.add_argument("--timeout-s", type=float, default=None,
@@ -51,6 +58,17 @@ def main(argv=None) -> int:
                         help="(self-contained) worker pool size")
     parser.add_argument("--max-queue-depth", type=int, default=64,
                         help="(self-contained) admission queue bound")
+    parser.add_argument("--engine", action="store_true",
+                        help="(self-contained) serve through the "
+                             "continuous-batching decode engine")
+    parser.add_argument("--prefix-cache", action="store_true",
+                        help="(self-contained) enable the engine's "
+                             "cross-request prefix KV cache (implies "
+                             "--engine)")
+    parser.add_argument("--engine-options", default="{}",
+                        help="(self-contained) JSON object of extra "
+                             "DecodeEngine options (slots, num_pages, "
+                             "prefix_cache_pages, ...)")
     parser.add_argument("--brownout", action="store_true",
                         help="(self-contained) enable the brownout "
                              "controller: overloaded requests run at a "
@@ -102,6 +120,7 @@ def main(argv=None) -> int:
         base_seed=args.seed,
         evaluate=args.evaluate,
         timeout_s=args.timeout_s,
+        scenario_repeat=args.scenario_repeat,
     )
 
     if args.self_contained:
@@ -109,6 +128,9 @@ def main(argv=None) -> int:
         from consensus_tpu.serve import create_server
         from consensus_tpu.utils.io_atomic import atomic_write_json
 
+        engine_options = json.loads(args.engine_options) or {}
+        if args.prefix_cache:
+            engine_options.setdefault("prefix_cache", True)
         server = create_server(
             backend="fake",
             port=0,  # ephemeral
@@ -117,6 +139,8 @@ def main(argv=None) -> int:
             fault_plan=args.fault_plan,
             brownout=args.brownout or args.target_p95_ms is not None,
             target_p95_ms=args.target_p95_ms,
+            engine=args.engine or args.prefix_cache or bool(engine_options),
+            engine_options=engine_options or None,
             fleet_size=args.fleet,
             fleet_options=json.loads(args.fleet_options) or None,
         ).start()

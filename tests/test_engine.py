@@ -28,7 +28,12 @@ from consensus_tpu.backends.fake import FakeBackend
 from consensus_tpu.methods import get_method_generator
 from consensus_tpu.obs.backends import bucket_recompiles
 from consensus_tpu.obs.metrics import Registry, diff_snapshots
-from consensus_tpu.ops.kv_pages import BlockTable, PagePool, PagePoolExhausted
+from consensus_tpu.ops.kv_pages import (
+    BlockTable,
+    PagePool,
+    PagePoolExhausted,
+    PrefixCache,
+)
 
 ISSUE = "Should the city invest in more bike lanes?"
 OPINIONS = {
@@ -134,6 +139,109 @@ class TestPagePool:
         assert pool.pages_for_tokens(16) == 1
         assert pool.pages_for_tokens(17) == 2
 
+    # -- refcounted sharing (prefix cache) ---------------------------------
+
+    def test_shared_page_survives_first_free(self):
+        """free() drops one reference; the page rejoins the free list only
+        when the LAST holder lets go."""
+        pool = PagePool(8, page_size=4)
+        pages = pool.alloc(2, owner="slot")
+        pool.share(pages)  # cache pins them
+        assert all(pool.refcount(p) == 2 for p in pages)
+        pool.free(pages)  # slot retires
+        assert pool.in_use == 2 and pool.free_count == 6
+        assert all(pool.refcount(p) == 1 for p in pages)
+        pool.free(pages)  # cache evicts
+        assert pool.in_use == 0 and pool.free_count == 8
+
+    def test_double_free_of_shared_page_still_raises(self):
+        """Sharing must not launder a double free: once every reference is
+        gone, another free raises exactly like the unshared case."""
+        pool = PagePool(4, page_size=4)
+        pages = pool.alloc(1)
+        pool.share(pages)
+        pool.free(pages)
+        pool.free(pages)
+        with pytest.raises(ValueError, match="double free|not allocated"):
+            pool.free(pages)
+
+    def test_share_free_page_raises(self):
+        pool = PagePool(4, page_size=4)
+        pages = pool.alloc(1)
+        pool.free(pages)
+        with pytest.raises(ValueError, match="cannot share a free page"):
+            pool.share(pages)
+        with pytest.raises(ValueError):
+            pool.share([99])
+
+    def test_freed_while_refcounted_page_is_not_reallocated(self):
+        """A page another holder still references must never come back out
+        of alloc() — the aliasing bug refcounting exists to prevent."""
+        pool = PagePool(4, page_size=4)
+        shared = pool.alloc(2, owner="a")
+        pool.share(shared)
+        pool.free(shared)  # one reference remains
+        grabbed = pool.alloc(2, owner="b")  # only the 2 never-shared pages
+        assert not (set(grabbed) & set(shared))
+        with pytest.raises(PagePoolExhausted):
+            pool.alloc(1, owner="c")
+
+    def test_no_aliasing_under_churn_with_sharing(self):
+        """Mixed private/shared churn keeps the invariant: at every step a
+        page is either free, or held by exactly its current reference
+        holders — never handed out twice."""
+        pool = PagePool(16, page_size=4)
+        private = {}  # step -> pages (one ref)
+        shared = {}  # step -> pages (two refs: "slot" + "cache")
+        for step in range(300):
+            action = step % 5
+            if action == 0 and pool.free_count >= 2:
+                private[step] = pool.alloc(2, owner=step)
+            elif action == 1 and pool.free_count >= 1:
+                pages = pool.alloc(1, owner=step)
+                pool.share(pages)
+                shared[step] = pages
+            elif action == 2 and private:
+                pool.free(private.pop(sorted(private)[0]))
+            elif action == 3 and shared:
+                # Drop ONE of the two references; entry stays live.
+                key = sorted(shared)[0]
+                pool.free(shared[key])
+                private[key] = shared.pop(key)
+            elif action == 4 and private:
+                pool.free(private.pop(sorted(private)[-1]))
+            held = [
+                p for pages in list(private.values()) + list(shared.values())
+                for p in pages
+            ]
+            assert len(held) == len(set(held))
+            assert pool.in_use == len(held)
+            for pages in shared.values():
+                assert all(pool.refcount(p) == 2 for p in pages)
+        for pages in private.values():
+            pool.free(pages)
+        for pages in shared.values():
+            pool.free(pages)
+            pool.free(pages)
+        assert pool.in_use == 0 and pool.free_count == 16
+
+    def test_adopt_shared_requires_alignment_and_empty_table(self):
+        pool = PagePool(8, page_size=4)
+        donor = BlockTable(0)
+        donor.append_tokens(pool, 8)
+        table = BlockTable(1)
+        with pytest.raises(ValueError, match="page-aligned"):
+            table.adopt_shared(pool, donor.pages, 7)
+        table.adopt_shared(pool, donor.pages, 8)
+        assert table.num_tokens == 8 and table.pages == donor.pages
+        with pytest.raises(ValueError, match="empty block table"):
+            table.adopt_shared(pool, donor.pages, 8)
+        # The adopter's release leaves the donor's reference intact.
+        table.release(pool)
+        assert pool.in_use == 2
+        donor.release(pool)
+        assert pool.in_use == 0
+
 
 class TestBlockTable:
     def test_append_allocates_on_page_boundaries_only(self):
@@ -199,6 +307,170 @@ class TestByteIdentity:
 
         assert via_engine == solo, f"{method}: engine result diverged"
         assert via_legacy == solo, f"{method}: legacy result diverged"
+
+
+# ---------------------------------------------------------------------------
+# Prefix KV cache
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixCache:
+    def _cache(self, num_pages=16, max_pages=8, identity=("m", "dense")):
+        pool = PagePool(num_pages, page_size=4)
+        return pool, PrefixCache(pool, max_pages, identity=identity)
+
+    def test_miss_then_hit_roundtrip(self):
+        pool, cache = self._cache()
+        tokens = list(range(8))
+        assert cache.lookup(tokens) == ([], 0)
+        pages = pool.alloc(2, owner="slot")
+        assert cache.insert(tokens, pages)
+        got_pages, got_tokens = cache.lookup(tokens + [99, 98])
+        assert got_pages == pages and got_tokens == 8
+        # Three holders now: slot, cache, and the lookup's adopter.
+        assert all(pool.refcount(p) == 3 for p in pages)
+        stats = cache.stats()
+        assert stats["hits"] == 1 and stats["misses"] == 1
+        assert stats["tokens_saved"] == 8
+
+    def test_lookup_returns_longest_prefix(self):
+        pool, cache = self._cache()
+        short, long_ = list(range(4)), list(range(8))
+        p_short = pool.alloc(1, owner="a")
+        p_long = pool.alloc(2, owner="b")
+        assert cache.insert(short, p_short)
+        assert cache.insert(long_, p_long)
+        pages, n = cache.lookup(long_ + [42])
+        assert (pages, n) == (p_long, 8)
+        # A stream sharing only the first page matches the short entry.
+        pages, n = cache.lookup(short + [77, 77, 77, 77])
+        assert (pages, n) == (p_short, 4)
+
+    def test_unaligned_or_oversized_insert_rejected(self):
+        pool, cache = self._cache(max_pages=1)
+        pages = pool.alloc(2, owner="a")
+        assert not cache.insert(list(range(7)), pages)  # unaligned
+        assert not cache.insert(list(range(8)), pages)  # over budget
+        assert not cache.insert([], [])  # empty
+        assert pool.refcount(pages[0]) == 1  # no stray references taken
+
+    def test_identity_partitions_the_keyspace(self):
+        """Same token stream, different (tier, quant) identity — never the
+        same entry: two tiers' KV bytes must not alias."""
+        pool = PagePool(16, page_size=4)
+        a = PrefixCache(pool, 8, identity=("m", "dense"))
+        b = PrefixCache(pool, 8, identity=("m", "int8"))
+        tokens = list(range(8))
+        pages = pool.alloc(2, owner="x")
+        assert a.insert(tokens, pages)
+        assert b.lookup(tokens) == ([], 0)
+        assert a.lookup(tokens)[1] == 8
+
+    def test_lru_eviction_frees_cache_reference_only(self):
+        pool, cache = self._cache(max_pages=2)
+        first = pool.alloc(2, owner="a")
+        assert cache.insert(list(range(8)), first)
+        pool.free(first)  # slot retires; cache holds the last reference
+        second = pool.alloc(2, owner="b")
+        assert cache.insert(list(range(100, 108)), second)  # evicts first
+        assert cache.stats()["evictions"] == 1
+        assert cache.stats()["pages"] == 2
+        # The evicted entry's pages went back to the free list...
+        assert pool.in_use == 2
+        # ...and the survivor is still servable.
+        assert cache.lookup(list(range(100, 108)))[1] == 8
+
+    def test_eviction_spares_pages_adopted_by_live_slots(self):
+        pool, cache = self._cache(max_pages=2)
+        first = pool.alloc(2, owner="a")
+        assert cache.insert(list(range(8)), first)
+        pool.free(first)
+        adopted, n = cache.lookup(list(range(8)))  # a live slot adopts
+        assert n == 8
+        second = pool.alloc(2, owner="b")
+        assert cache.insert(list(range(100, 108)), second)  # evicts entry
+        # The entry is gone but the adopter's reference keeps pages alive.
+        assert cache.lookup(list(range(8)))[1] == 0
+        assert all(pool.refcount(p) == 1 for p in adopted)
+        pool.free(adopted)
+        assert pool.in_use == 2  # only the second entry's pages remain
+
+
+class TestEnginePrefixByteIdentity:
+    """With the prefix cache ON the engine must return byte-identical
+    results for every method — the cache only changes which prefill work
+    runs, never what any request computes."""
+
+    @pytest.mark.parametrize("method", sorted(METHOD_PARAMS))
+    def test_cache_on_equals_cache_off(self, method):
+        params = METHOD_PARAMS[method]
+
+        def run(**engine_options):
+            backend = BatchingBackend(
+                FakeBackend(), engine=True,
+                engine_options={"slots": 4, "num_pages": 512,
+                                **engine_options},
+            )
+            try:
+                statement = get_method_generator(
+                    method, backend, dict(params)
+                ).generate_statement(ISSUE, OPINIONS)
+                stats = backend.engine.stats()
+            finally:
+                backend.close()
+            return statement, stats
+
+        off, stats_off = run()
+        on, stats_on = run(prefix_cache=True)
+        assert on == off, f"{method}: prefix cache changed the statement"
+        assert stats_off["prefix_cache"] == {"enabled": False}
+        assert stats_on["prefix_cache"]["enabled"]
+
+    def test_repeated_requests_hit_and_leave_no_leak(self):
+        backend = BatchingBackend(
+            FakeBackend(), engine=True,
+            engine_options={"slots": 4, "page_size": 4, "num_pages": 64,
+                            "prefix_cache": True},
+        )
+        req = GenerationRequest(
+            user_prompt="alpha beta gamma delta epsilon zeta eta theta",
+            max_tokens=8, seed=3,
+        )
+        solo = FakeBackend().generate([req, req])
+        try:
+            first = backend.generate([req])
+            second = backend.generate([req])
+            stats = backend.engine.stats()["prefix_cache"]
+            engine = backend.engine
+            # Cached pages stay pinned by the cache; nothing else leaks.
+            assert engine.pool.in_use == stats["pages"]
+        finally:
+            backend.close()
+        assert first[0].text == solo[0].text
+        assert second[0].text == solo[1].text
+        assert stats["hits"] >= 1
+        assert stats["tokens_saved"] > 0
+        assert stats["inserted_pages"] >= 1
+
+    def test_prefix_metrics_families_emitted(self):
+        reg = Registry()
+        engine = DecodeEngine(
+            FakeBackend(), slots=2, page_size=4, num_pages=64,
+            prefix_cache=True, registry=reg,
+        )
+        req = GenerationRequest(
+            user_prompt="one two three four five six seven eight",
+            max_tokens=4, seed=1,
+        )
+        try:
+            engine.submit("generate", [req])
+            engine.submit("generate", [req])
+        finally:
+            engine.close()
+        assert _counter_total(reg, "prefix_cache_hits_total") >= 1
+        assert _counter_total(reg, "prefix_cache_misses_total") >= 1
+        assert _counter_total(reg, "prefix_tokens_saved_total") > 0
+        assert _counter_total(reg, "prefix_cache_inserted_pages_total") >= 1
 
 
 # ---------------------------------------------------------------------------
@@ -555,3 +827,88 @@ class TestPagedProgramNumerics:
             )
             last = lg[0]
         assert paged_tokens == dense_tokens
+
+    @pytest.mark.parametrize("cfg_name", ["tiny-gemma2", "tiny-llama3"])
+    def test_gather_step_reads_shared_pages_without_copying(self, cfg_name):
+        """The prefix-cache gather path: slot 1 adopts slot 0's prompt
+        pages (refcounted, read-only) and ``paged_gather_step`` must
+        reproduce the dense last-prompt-position logits from them — while
+        leaving every shared page's bytes untouched."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from consensus_tpu.models import stepper
+        from consensus_tpu.models.config import get_model_config
+        from consensus_tpu.models.transformer import (
+            forward, init_params, make_cache, project_logits,
+        )
+
+        cfg = get_model_config(cfg_name)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(1)
+        prompt = rng.randint(1, cfg.vocab_size, size=(8,)).astype(np.int32)
+
+        # Dense reference logits at the last prompt position.
+        cache = make_cache(cfg, 1, 32, jnp.float32)
+        logits, _ = forward(
+            params, cfg, jnp.asarray(prompt)[None, :],
+            jnp.arange(8)[None, :], jnp.ones((1, 8), bool), cache, 0,
+        )
+        dense_last = np.asarray(logits[0, -1])
+
+        # Slot 0 prefills the prompt into its own pages (page-aligned).
+        page_size, num_pages, max_blocks = 4, 16, 8
+        pool = PagePool(num_pages, page_size)
+        state = stepper.make_page_state(cfg, num_pages, page_size, jnp.float32)
+        sink = num_pages
+        owner = BlockTable(0)
+        owner.append_tokens(pool, 8)
+        tok = np.zeros((2, 8), np.int32)
+        cvalid = np.zeros((2, 8), bool)
+        wp = np.full((2, 8), sink, np.int32)
+        wo = np.zeros((2, 8), np.int32)
+        tok[0] = prompt
+        cvalid[0] = True
+        for t in range(8):
+            wp[0, t] = owner.pages[t // page_size]
+            wo[0, t] = t % page_size
+        tables = np.full((2, max_blocks), -1, np.int32)
+        tables[0] = owner.as_array(max_blocks)
+        hidden, state = stepper.paged_prefill_chunk(
+            params, cfg, jnp.asarray(tok), jnp.asarray(cvalid), state,
+            jnp.asarray(tables), jnp.asarray([8, 0], np.int32),
+            jnp.asarray(wp), jnp.asarray(wo),
+        )
+        prefill_last = np.asarray(project_logits(params, cfg, hidden)[0])
+        np.testing.assert_allclose(
+            prefill_last, dense_last, rtol=2e-4, atol=2e-4
+        )
+
+        # Slot 1 adopts the SAME pages via the refcounted share path.
+        adopter = BlockTable(1)
+        adopter.adopt_shared(pool, owner.pages, 8)
+        assert all(pool.refcount(p) == 2 for p in owner.pages)
+        g_tables = np.full((2, max_blocks), -1, np.int32)
+        g_tables[0] = owner.as_array(max_blocks)
+        g_tables[1] = adopter.as_array(max_blocks)
+        shared_before = np.asarray(
+            state.k_pages[:, owner.pages, :, :, :]
+        ).copy()
+        g_logits, state = stepper.paged_gather_step(
+            params, cfg,
+            jnp.asarray([int(prompt[-1]), int(prompt[-1])], jnp.int32),
+            state, jnp.asarray(g_tables), jnp.asarray([8, 8], np.int32),
+        )
+        # Both slots read the one shared copy and reproduce the dense
+        # logits at the last prompt position...
+        np.testing.assert_allclose(
+            np.asarray(g_logits[0]), dense_last, rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(g_logits[1]), dense_last, rtol=2e-4, atol=2e-4
+        )
+        # ...and the shared pages' bytes are bit-identical afterwards
+        # (every write went to the sink page).
+        shared_after = np.asarray(state.k_pages[:, owner.pages, :, :, :])
+        np.testing.assert_array_equal(shared_before, shared_after)

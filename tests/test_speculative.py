@@ -1,0 +1,189 @@
+"""Speculative rollout verification: drafts, rejection, and equivalence.
+
+The speculative path (backends/speculative.py:NGramProposer +
+models/stepper.rollout_verify_many + the session's _rollout_many_spec loop)
+must be invisible in results: token streams identical to the sequential
+rollout scan, agent totals to float tolerance (the one-pass verify
+projects logits at a different matmul shape than the step-by-step scan —
+same contract the batched rollout tests pin for rollout_many vs
+rollout_from), and whole-method statements byte-identical with
+``speculative_rollouts`` on vs off.
+"""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.session import SearchSpec
+from consensus_tpu.backends.speculative import NGramProposer
+from consensus_tpu.backends.tpu import TPUBackend, TPUTokenSearchSession
+
+ISSUE = "Should the town build a new library?"
+OPINIONS = {
+    "Agent 1": "Yes, libraries anchor the community.",
+    "Agent 2": "Only if it does not raise taxes.",
+}
+
+
+# ---------------------------------------------------------------------------
+# Host-side proposer (no model, no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestNGramProposer:
+    def test_draft_replays_observed_pattern(self):
+        p = NGramProposer(max_order=3)
+        p.observe([1, 2, 3, 4, 1, 2, 3])
+        # Longest suffix (2, 3) was followed by 4; then (3, 4) by 1, ...
+        assert p.draft([1, 2, 3], 4) == [4, 1, 2, 3]
+
+    def test_latest_occurrence_wins(self):
+        p = NGramProposer(max_order=2)
+        p.observe([5, 6, 7])  # (5, 6) -> 7
+        p.observe([5, 6, 9])  # (5, 6) -> 9 overwrites
+        assert p.draft([5, 6], 1) == [9]
+
+    def test_longest_order_preferred(self):
+        p = NGramProposer(max_order=3)
+        p.observe([1, 2, 3, 8])  # (1,2,3)->8, (2,3)->8, (3,)->8
+        p.observe([9, 2, 3, 4])  # (9,2,3)->4, (2,3)->4, (3,)->4
+        # Order-3 context (1, 2, 3) still remembers 8 even though the
+        # order-2 table was overwritten with 4.
+        assert p.draft([1, 2, 3], 1) == [8]
+        assert p.draft([7, 2, 3], 1) == [4]
+
+    def test_unseen_context_repeats_last_token(self):
+        p = NGramProposer()
+        p.observe([1, 2])
+        assert p.draft([40, 41], 3) == [41, 41, 41]
+        assert p.draft([], 2) == [0, 0]
+
+    def test_deterministic_across_instances(self):
+        history = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5]
+        a, b = NGramProposer(), NGramProposer()
+        a.observe(history)
+        b.observe(history)
+        assert a.draft([5, 3, 5], 6) == b.draft([5, 3, 5], 6)
+
+
+# ---------------------------------------------------------------------------
+# Device verify path vs the sequential scan (tiny real model)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return TPUBackend(model="tiny-gemma2", dtype="float32", max_context=256)
+
+
+def make_spec(**kw):
+    defaults = dict(
+        ref_system="You draft consensus statements.",
+        ref_user="Issue: taxes.\nOpinions: A wants more, B wants less."
+                 "\nStatement:",
+        agent_prompts=(
+            ("Agent context.", "Opinion: A wants more.\nStatement:"),
+            ("Agent context.", "Opinion: B wants less.\nStatement:"),
+        ),
+        n_slots=1, k=3, temperature=1.0, seed=11, sample=False, max_steps=8,
+    )
+    defaults.update(kw)
+    return SearchSpec(**defaults)
+
+
+def test_spec_rollouts_match_sequential_scan(backend):
+    """Speculative rollout_many == plain rollout_many: exact ids and text
+    (the rejection construction replays every sampling decision), totals
+    to float tolerance."""
+    plain = TPUTokenSearchSession(backend, make_spec())
+    root = plain.propose()[0]
+    suffixes = [[root[0]], [root[1]], [root[0], root[1]]]
+    want = plain.rollout_many(suffixes, depth=5, salts=[9, 10, 11])
+    plain.close()
+
+    spec = TPUTokenSearchSession(backend, make_spec(speculative=True))
+    root2 = spec.propose()[0]
+    assert [c.token_id for c in root2] == [c.token_id for c in root]
+    suffixes2 = [[root2[0]], [root2[1]], [root2[0], root2[1]]]
+    got = spec.rollout_many(suffixes2, depth=5, salts=[9, 10, 11])
+    # Determinism across repeat speculative calls (proposer state grew).
+    again = spec.rollout_many(suffixes2, depth=5, salts=[9, 10, 11])
+    spec.close()
+
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g[0] == w[0], f"path {i}: token ids diverged"
+        assert g[1] == w[1], f"path {i}: text diverged"
+        np.testing.assert_allclose(g[2], w[2], atol=2e-3)
+        assert g[3] == w[3]
+    assert [r[0] for r in again] == [r[0] for r in got]
+
+
+def test_spec_rollouts_emit_draft_counters(backend):
+    from consensus_tpu.obs.metrics import diff_snapshots
+
+    reg = backend.instruments.registry
+    before = reg.snapshot()
+    spec = TPUTokenSearchSession(backend, make_spec(speculative=True))
+    root = spec.propose()[0]
+    spec.rollout_many([[root[0]], [root[1]]], depth=4, salts=[1, 2])
+    spec.close()
+    delta = diff_snapshots(before, reg.snapshot())
+
+    def total(name):
+        family = (delta.get("families") or {}).get(name) or {}
+        return sum(s.get("value", 0) for s in family.get("series", []))
+
+    proposed = total("spec_draft_proposed_tokens_total")
+    verified = total("spec_draft_verified_tokens_total")
+    assert proposed > 0
+    assert 0 <= verified <= proposed
+
+
+@pytest.mark.parametrize("method,cfg", [
+    ("mcts", {"num_simulations": 3, "expansion_sample_width": 2,
+              "max_tokens": 3, "rollout_depth": 3, "seed": 6}),
+    ("finite_lookahead", {"branching_factor": 2, "max_depth": 2,
+                          "max_tokens": 3, "rollout_depth": 3, "seed": 9}),
+])
+def test_method_statement_identical_spec_on_off(backend, method, cfg):
+    from consensus_tpu.methods import get_method_generator
+
+    plain = get_method_generator(
+        method, backend, dict(cfg)
+    ).generate_statement(ISSUE, OPINIONS)
+    spec = get_method_generator(
+        method, backend, {**cfg, "speculative_rollouts": True}
+    ).generate_statement(ISSUE, OPINIONS)
+    assert spec == plain
+
+
+def test_finite_lookahead_rollout_depth_zero_is_unchanged(backend):
+    """rollout_depth is opt-in: the default config must take the exact
+    pre-change path (no rollout dispatches at all)."""
+    from consensus_tpu.methods import get_method_generator
+
+    cfg = {"branching_factor": 2, "max_depth": 2, "max_tokens": 2, "seed": 4}
+    a = get_method_generator(
+        method := "finite_lookahead", backend, dict(cfg)
+    ).generate_statement(ISSUE, OPINIONS)
+    b = get_method_generator(
+        method, backend, {**cfg, "rollout_depth": 0}
+    ).generate_statement(ISSUE, OPINIONS)
+    assert a == b
+
+
+def test_fallback_session_accepts_speculative_flag():
+    """The cacheless fallback session ignores ``speculative`` (its rollout
+    is already one batched generate) — methods must run unchanged on
+    backends without a TPU session."""
+    from consensus_tpu.backends.fake import FakeBackend
+    from consensus_tpu.methods import get_method_generator
+
+    cfg = {"num_simulations": 2, "expansion_sample_width": 2,
+           "max_tokens": 2, "rollout_depth": 2, "seed": 1}
+    plain = get_method_generator(
+        "mcts", FakeBackend(), dict(cfg)
+    ).generate_statement(ISSUE, OPINIONS)
+    spec = get_method_generator(
+        "mcts", FakeBackend(), {**cfg, "speculative_rollouts": True}
+    ).generate_statement(ISSUE, OPINIONS)
+    assert spec == plain
