@@ -620,6 +620,36 @@ def main() -> None:
                              "streams) is the part pinned in CI",
             })
 
+    # ---- BENCH_MESH: dp scaling of the mesh serving path -----------------
+    # Statements/sec efficiency of the engine partitioned over a dp=4 mesh
+    # vs one device, plus the two identity invariants (dp=1 byte-identical
+    # to the plain engine path; texts identical across dp widths).  Runs as
+    # a SUBPROCESS: this process already initialized the real device
+    # platform and cannot re-init as 8 emulated CPU devices.  BENCH_MESH=0
+    # skips.
+    mesh_extra = {}
+    if os.environ.get("BENCH_MESH", "1") != "0":
+        import subprocess
+        import sys as _sys
+
+        mesh_env = dict(os.environ)
+        mesh_env["JAX_PLATFORMS"] = "cpu"
+        mesh_env.pop("XLA_FLAGS", None)  # cell sets its own device count
+        mesh_proc = subprocess.run(
+            [_sys.executable, "-m", "consensus_tpu.cli.bench_mesh"],
+            env=mesh_env, capture_output=True, text=True, timeout=600,
+        )
+        if mesh_proc.returncode == 0:
+            mesh_extra = json.loads(mesh_proc.stdout.splitlines()[-1])
+            mesh_extra["bench_mesh"]["goal"] = (
+                ">=0.7 scaling efficiency at dp=4 with both identity "
+                "invariants true"
+            )
+        else:
+            mesh_extra = {"bench_mesh": {
+                "error": (mesh_proc.stderr or mesh_proc.stdout)[-2000:],
+            }}
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -639,6 +669,13 @@ def main() -> None:
     padding_eff = padding_efficiency(metrics_timed)
     throughput_tflops = useful_tflops_per_sec(
         n_params, bench_total_tokens, sum(trial_walls)
+    )
+    # Peak FLOPs scale with the mesh: a dp*tp slice has that many chips'
+    # worth of silicon, and %-of-peak must divide by ALL of it or multichip
+    # runs flatter themselves.  Single-chip runs: mesh_devices == 1,
+    # numbers unchanged.
+    mesh_devices = (
+        backend.mesh_plan.n_devices if backend.mesh_plan is not None else 1
     )
     print(
         json.dumps(
@@ -697,12 +734,17 @@ def main() -> None:
                     ),
                     "throughput_tflops_per_sec": round(throughput_tflops, 2),
                     "throughput_pct_of_v5e_bf16_peak": round(
-                        pct_of_peak(throughput_tflops), 2
+                        pct_of_peak(throughput_tflops, n_devices=mesh_devices),
+                        2,
                     ),
+                    "mesh_devices": mesh_devices,
                     "mfu_accounting": (
                         f"2*{n_params:.3g} params * {bench_total_tokens} "
                         "generated+scored tokens / wall; peak "
-                        f"{V5E_BF16_PEAK_TFLOPS} TFLOP/s (v5e bf16); "
+                        f"{V5E_BF16_PEAK_TFLOPS} TFLOP/s (v5e bf16) x "
+                        f"{mesh_devices} mesh device(s) — %-of-peak divides "
+                        "by the WHOLE slice's silicon, so multichip runs "
+                        "can't flatter themselves; "
                         "counts USEFUL tokens only — bucket padding, "
                         "KV/weight HBM traffic, and host/RTT overheads all "
                         "show up as lost MFU, which is the point; "
@@ -732,6 +774,7 @@ def main() -> None:
                     **brownout_extra,
                     **fleet_extra,
                     **prefix_extra,
+                    **mesh_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

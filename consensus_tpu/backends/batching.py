@@ -87,6 +87,7 @@ class BatchingBackend:
         engine: bool = False,
         engine_options: Optional[Dict[str, Any]] = None,
         prefix_cache: bool = False,
+        mesh: Optional[Any] = None,
     ):
         self.inner = inner
         #: Convenience flag: ``prefix_cache=True`` folds into the engine
@@ -94,6 +95,11 @@ class BatchingBackend:
         #: pool to cache into).  An explicit ``engine_options`` key wins.
         if prefix_cache:
             engine_options = {"prefix_cache": True, **dict(engine_options or {})}
+        #: Mesh passthrough: ``mesh={'dp': N, 'tp': M}`` (or "dp=4,tp=2")
+        #: reaches the decode engine's shard partitioning the same way.
+        #: Left unset, the engine inherits the inner backend's mesh_plan.
+        if mesh is not None:
+            engine_options = {"mesh": mesh, **dict(engine_options or {})}
         self.flush_s = flush_ms / 1000.0
         # obs: queue-wait (enqueue -> dispatch), batch-fill (sessions merged
         # per flush), and flush-reason accounting.  ``registry`` isolates

@@ -132,10 +132,10 @@ class _Row:
 class _Slot:
     __slots__ = (
         "idx", "row", "table", "prefilled", "state", "reserved",
-        "cached_tokens",
+        "cached_tokens", "shard",
     )
 
-    def __init__(self, idx: int, row: _Row, reserved: int):
+    def __init__(self, idx: int, row: _Row, reserved: int, shard: int = 0):
         self.idx = idx
         self.row = row
         self.table = BlockTable(idx)
@@ -148,6 +148,10 @@ class _Slot:
         #: Prompt tokens adopted from the prefix cache (page-aligned) —
         #: their prefill chunks are skipped entirely.
         self.cached_tokens = 0
+        #: Data-parallel shard this slot lives on (mesh mode): its pages
+        #: come from ``pools[shard]`` and its prefix hits from that shard's
+        #: cache — pages never cross dp replicas.
+        self.shard = shard
 
 
 class DecodeEngine:
@@ -167,22 +171,49 @@ class DecodeEngine:
         auto_start: bool = True,
         prefix_cache: bool = False,
         prefix_cache_pages: Optional[int] = None,
+        mesh: Optional[Any] = None,
     ):
         self.inner = inner
         self.n_slots = max(1, int(slots))
+        # Mesh mode: ``mesh`` is a {'dp': N, 'tp': M} dict, a "dp=4,tp=2"
+        # string, or a MeshPlan.  Left unset, the engine inherits the inner
+        # backend's mesh — a TPUBackend built over the full slice serves
+        # mesh-wide by default, no extra plumbing.
+        if mesh is None:
+            mesh = getattr(inner, "mesh_plan", None)
+        if mesh is not None:
+            from consensus_tpu.parallel.mesh import parse_mesh_spec
+
+            mesh = parse_mesh_spec(mesh)
+        self.mesh_dp = int(mesh["dp"]) if mesh else 1
+        self.mesh_tp = int(mesh["tp"]) if mesh else 1
         if num_pages is None:
             suggest = getattr(inner, "suggest_kv_page_pool", None)
             num_pages = (
                 suggest(page_size) if callable(suggest) else DEFAULT_NUM_PAGES
             )
-        self.pool = PagePool(int(num_pages), page_size)
+        #: One page pool PER data-parallel shard, each at the full per-chip
+        #: size (dp chips carry dp× the HBM, so aggregate KV capacity scales
+        #: with the mesh).  Pages never migrate between shards — a slot's
+        #: block table names pages of its own shard's pool only.  dp=1
+        #: degenerates to the single pool of the PR 6 engine, byte-for-byte.
+        self.pools: List[PagePool] = [
+            PagePool(int(num_pages), page_size) for _ in range(self.mesh_dp)
+        ]
+        self.pool = self.pools[0]  # dp=1 alias; shard-0 pool under a mesh
         #: Cross-request prefix KV reuse (ROADMAP item 3): completed
         #: prompts donate their page-aligned prefix pages to a
         #: content-addressed LRU; admission adopts the longest cached
         #: prefix and skips its prefill chunks entirely.  The budget
         #: defaults to a quarter of the pool — the share
         #: ``suggest_kv_page_pool`` already reserves headroom for.
-        self.prefix_cache: Optional[PrefixCache] = None
+        #: Mesh mode keeps one cache PER dp shard (cached pages live in a
+        #: shard's pool and cannot be adopted across shards); the identity
+        #: already carries the backend's tp width via kv_cache_identity, so
+        #: tp=1 and tp=2 content keys never alias.
+        self.prefix_caches: List[Optional[PrefixCache]] = [
+            None for _ in range(self.mesh_dp)
+        ]
         if prefix_cache:
             identity_fn = getattr(inner, "kv_cache_identity", None)
             identity = (
@@ -194,9 +225,11 @@ class DecodeEngine:
                 if prefix_cache_pages is not None
                 else max(1, self.pool.num_pages // 4)
             )
-            self.prefix_cache = PrefixCache(
-                self.pool, budget, identity=identity
-            )
+            self.prefix_caches = [
+                PrefixCache(pool, budget, identity=identity)
+                for pool in self.pools
+            ]
+        self.prefix_cache = self.prefix_caches[0]
         self.prefill_chunk = max(1, int(prefill_chunk))
         #: Decode dispatch heuristic: with prefills still in progress, hold
         #: the cohort until at least this many slots are ready — avoids
@@ -214,6 +247,18 @@ class DecodeEngine:
             "Occupied fraction of the decode engine's slot table at the "
             "latest iteration.",
         )
+        self._m_mesh_dp = reg.gauge(
+            "engine_mesh_dp",
+            "Data-parallel width of the mesh this engine partitions its "
+            "slots and page pools over (1 = single device).",
+        )
+        self._m_mesh_tp = reg.gauge(
+            "engine_mesh_tp",
+            "Tensor-parallel width of the mesh under this engine's inner "
+            "backend (1 = unsharded params).",
+        )
+        self._m_mesh_dp.set(self.mesh_dp)
+        self._m_mesh_tp.set(self.mesh_tp)
         self._m_tokens_iter = reg.histogram(
             "engine_tokens_per_iteration",
             "Generated tokens retired per decode-cohort iteration.",
@@ -281,7 +326,9 @@ class DecodeEngine:
             "score": [], "next_token": [], "embed": [],
         }
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
-        self._reserved_pages = 0
+        #: Per-dp-shard page reservations (index = shard); the legacy
+        #: single-pool figure is the sum.
+        self._reserved: List[int] = [0] * self.mesh_dp
         self._stopped = False
         #: Latched when a dispatch raises BackendLostError: the device under
         #: this engine is gone for good (BackendLostError is sticky by
@@ -353,7 +400,27 @@ class DecodeEngine:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             occupied = sum(1 for s in self._slots if s is not None)
-            pool = self.pool.stats()
+            pools = [pool.stats() for pool in self.pools]
+            shard_occupied = [0] * self.mesh_dp
+            for s in self._slots:
+                if s is not None:
+                    shard_occupied[s.shard] += 1
+            if self.prefix_cache is not None:
+                # Aggregate the per-shard caches into one legacy-shaped
+                # block (counters sum; rates recompute from the sums).
+                cache_stats = [c.stats() for c in self.prefix_caches]
+                agg = {
+                    key: sum(cs[key] for cs in cache_stats)
+                    for key in (
+                        "entries", "pages", "max_pages", "hits", "misses",
+                        "evictions", "inserted_pages", "tokens_saved",
+                    )
+                }
+                total = agg["hits"] + agg["misses"]
+                agg["hit_rate"] = (agg["hits"] / total) if total else 0.0
+                prefix_block: Dict[str, Any] = {"enabled": True, **agg}
+            else:
+                prefix_block = {"enabled": False}
             return {
                 "slots": self.n_slots,
                 "slots_occupied": occupied,
@@ -364,19 +431,29 @@ class DecodeEngine:
                 "iterations": self.iterations,
                 "queue_depth": len(self._gen_backlog)
                 + sum(len(q) for q in self._other.values()),
-                "kv_pages": pool.num_pages,
-                "kv_page_size": pool.page_size,
-                "kv_pages_in_use": pool.pages_in_use,
-                "kv_pages_reserved": self._reserved_pages,
-                "kv_pages_high_water": pool.high_water,
+                # Aggregates across every dp shard's pool (dp=1 == the
+                # single legacy pool, unchanged numbers).
+                "kv_pages": sum(p.num_pages for p in pools),
+                "kv_page_size": pools[0].page_size,
+                "kv_pages_in_use": sum(p.pages_in_use for p in pools),
+                "kv_pages_reserved": sum(self._reserved),
+                "kv_pages_high_water": sum(p.high_water for p in pools),
                 "fused_search_sessions": self._search_sessions,
                 "fused_search_slots": self._search_slots,
                 "backend_lost": self.backend_lost,
-                "prefix_cache": (
-                    {"enabled": True, **self.prefix_cache.stats()}
-                    if self.prefix_cache is not None
-                    else {"enabled": False}
-                ),
+                "prefix_cache": prefix_block,
+                "mesh": {
+                    "dp": self.mesh_dp,
+                    "tp": self.mesh_tp,
+                    "per_shard": [
+                        {
+                            "slots_occupied": shard_occupied[i],
+                            "kv_pages_in_use": pools[i].pages_in_use,
+                            "kv_pages_reserved": self._reserved[i],
+                        }
+                        for i in range(self.mesh_dp)
+                    ],
+                },
             }
 
     # -- loop --------------------------------------------------------------
@@ -492,6 +569,10 @@ class DecodeEngine:
 
     def _admit(self) -> None:
         free = [i for i, s in enumerate(self._slots) if s is None]
+        occupied = [0] * self.mesh_dp
+        for s in self._slots:
+            if s is not None:
+                occupied[s.shard] += 1
         while free and self._gen_backlog:
             row = self._gen_backlog[0]
             if row.item.failed:
@@ -504,17 +585,32 @@ class DecodeEngine:
                 self._gen_backlog.pop(0)
                 self._reject_oversized(row, needed)
                 continue
-            if self._reserved_pages + needed > self.pool.num_pages:
-                # Fits the pool but not right now — hold FIFO order and
-                # wait for resident rows to retire.
+            # Balanced admission: among free slots whose dp shard still has
+            # reservation headroom, take the one on the least-loaded shard
+            # (fewest resident rows, then fewest reserved pages, then lowest
+            # slot index — which at dp=1 is exactly the legacy FIFO pick).
+            best = None
+            best_key = None
+            for slot_idx in free:
+                shard = slot_idx % self.mesh_dp
+                if self._reserved[shard] + needed > self.pool.num_pages:
+                    continue
+                key = (occupied[shard], self._reserved[shard], slot_idx)
+                if best_key is None or key < best_key:
+                    best, best_key = slot_idx, key
+            if best is None:
+                # Fits a pool but not right now — hold FIFO order and wait
+                # for resident rows to retire.
                 break
             self._gen_backlog.pop(0)
+            free.remove(best)
+            shard = best % self.mesh_dp
+            pool = self.pools[shard]
+            cache = self.prefix_caches[shard]
             cached_pages: List[int] = []
             cached_tokens = 0
-            if self.prefix_cache is not None:
-                cached_pages, cached_tokens = self.prefix_cache.lookup(
-                    row.prompt_ids
-                )
+            if cache is not None:
+                cached_pages, cached_tokens = cache.lookup(row.prompt_ids)
                 if cached_tokens:
                     self._m_prefix_hits.inc()
                     self._m_prefix_saved.inc(cached_tokens)
@@ -522,15 +618,18 @@ class DecodeEngine:
                     self._m_prefix_misses.inc()
             # Shared pages come off the cache, not the free list — only the
             # private remainder counts against the reservation.
-            slot = _Slot(free.pop(0), row, reserved=needed - len(cached_pages))
+            slot = _Slot(
+                best, row, reserved=needed - len(cached_pages), shard=shard
+            )
             if cached_tokens:
-                slot.table.adopt_shared(self.pool, cached_pages, cached_tokens)
+                slot.table.adopt_shared(pool, cached_pages, cached_tokens)
                 slot.prefilled = cached_tokens
                 slot.cached_tokens = cached_tokens
                 if slot.prefilled >= row.prompt_tokens:
                     slot.state = _READY
             self._slots[slot.idx] = slot
-            self._reserved_pages += slot.reserved
+            self._reserved[shard] += slot.reserved
+            occupied[shard] += 1
             self._m_admitted.inc()
 
     def _advance_prefill(self) -> None:
@@ -541,7 +640,7 @@ class DecodeEngine:
             chunk = min(self.prefill_chunk, remaining)
             if chunk > 0:
                 # Reservation guarantees the pool has room.
-                slot.table.append_tokens(self.pool, chunk)
+                slot.table.append_tokens(self.pools[slot.shard], chunk)
                 slot.prefilled += chunk
                 self._m_prefill_chunks.inc()
                 self._m_prefill_tokens.inc(chunk)
@@ -559,9 +658,10 @@ class DecodeEngine:
             # Generated-token pages, allocated up front (the reservation
             # made at admission covers them); retired below with the slot.
             slot.table.append_tokens(
-                self.pool, int(getattr(slot.row.request, "max_tokens", 0))
+                self.pools[slot.shard],
+                int(getattr(slot.row.request, "max_tokens", 0)),
             )
-        self._m_pages.observe(self.pool.in_use)
+        self._m_pages.observe(sum(pool.in_use for pool in self.pools))
         return ready
 
     # -- dispatch (lock released) -------------------------------------------
@@ -653,25 +753,25 @@ class DecodeEngine:
     # -- bookkeeping (lock held) --------------------------------------------
 
     def _retire(self, slot: _Slot) -> None:
-        if self.prefix_cache is not None and slot.prefilled >= slot.row.prompt_tokens:
+        pool = self.pools[slot.shard]
+        cache = self.prefix_caches[slot.shard]
+        if cache is not None and slot.prefilled >= slot.row.prompt_tokens:
             # Donate the fully-prefilled, page-aligned prompt prefix before
             # releasing: the cache takes its own reference, so the pages
             # survive this slot's free below.  (Evicted mid-prefill slots
             # hold partial KV — never cacheable.)
-            ps = self.pool.page_size
+            ps = pool.page_size
             n_pages = slot.row.prompt_tokens // ps
             if n_pages > 0:
-                before = self.prefix_cache.evictions
-                if self.prefix_cache.insert(
+                before = cache.evictions
+                if cache.insert(
                     slot.row.prompt_ids[: n_pages * ps],
                     slot.table.pages[:n_pages],
                 ):
                     self._m_prefix_inserted.inc(n_pages)
-                self._m_prefix_evictions.inc(
-                    self.prefix_cache.evictions - before
-                )
-        slot.table.release(self.pool)
-        self._reserved_pages -= slot.reserved
+                self._m_prefix_evictions.inc(cache.evictions - before)
+        slot.table.release(pool)
+        self._reserved[slot.shard] -= slot.reserved
         self._slots[slot.idx] = None
 
     def _evict(self, slot: _Slot, count: bool = True) -> None:

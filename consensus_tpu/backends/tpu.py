@@ -167,7 +167,20 @@ class TPUBackend:
         decode_segment_len: int = 128,
         kv_quant: bool = True,
         quantize_frozen_kv: Optional[bool] = None,
+        mesh: Optional[Any] = None,
     ):
+        # ``mesh={'dp': N, 'tp': M}`` (or the "dp=4,tp=2" CLI string) is the
+        # serving-path spelling of the tp/dp pair — create_server and the
+        # sweep configs pass one opaque value straight through.  Explicit
+        # tp=/dp= args win when both are given.
+        if mesh is not None:
+            from consensus_tpu.parallel import parse_mesh_spec
+
+            parsed = parse_mesh_spec(mesh)
+            if tp == 1:
+                tp = parsed["tp"]
+            if dp is None:
+                dp = parsed["dp"]
         self.config = config if config is not None else get_model_config(model)
         if use_flash_attention and not self.config.use_flash_attention:
             import dataclasses
@@ -402,10 +415,18 @@ class TPUBackend:
 
     def kv_cache_identity(self) -> tuple:
         """Content-key identity for cross-request prefix KV reuse: two
-        backends may share cached prefix pages only when the model tier AND
-        the KV quantization mode match — the engine's PrefixCache folds
-        this into every blake2b content key (ops/kv_pages.py)."""
-        return (self.model_name, "int8" if self.kv_quant else "dense")
+        backends may share cached prefix pages only when the model tier, the
+        KV quantization mode AND the tensor-parallel width all match — the
+        engine's PrefixCache folds this into every blake2b content key
+        (ops/kv_pages.py).  tp enters because a tp=2 backend's pages hold
+        each chip's half of the kv heads: byte-compatible only with another
+        tp=2 mesh, never with tp=1.  (dp does NOT enter — pages replicate
+        over data, so any dp width reads tp-compatible pages.)"""
+        return (
+            self.model_name,
+            "int8" if self.kv_quant else "dense",
+            ("tp", self._shard_count),
+        )
 
     def suggest_kv_page_pool(self, page_size: int = 16) -> int:
         """Size the decode engine's KV page pool from the session HBM

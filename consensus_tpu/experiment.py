@@ -452,11 +452,27 @@ class Experiment:
                 "padding_efficiency": padding_efficiency(delta),
                 "bucket_recompiles": bucket_recompiles(delta),
             },
+            "mesh": self._mesh_labels(),
         }
         atomic_write_json(self.run_dir / "metrics.json", payload)
         atomic_write_text(
             self.run_dir / "metrics.prom", registry.to_prometheus()
         )
+
+    def _mesh_labels(self) -> Dict[str, int]:
+        """The device-mesh layout this cell ran on, so sweep readers can
+        tell a dp=4,tp=2 run from single-chip without re-deriving it from
+        throughput.  Unwraps batching/supervision decorators to find the
+        device backend; no mesh -> dp=1, tp=1."""
+        backend = self.backend
+        seen = set()
+        while backend is not None and id(backend) not in seen:
+            seen.add(id(backend))
+            plan = getattr(backend, "mesh_plan", None)
+            if plan is not None:
+                return {"dp": int(plan.dp), "tp": int(plan.tp)}
+            backend = getattr(backend, "inner", None)
+        return {"dp": 1, "tp": 1}
 
     def _write_token_counts(
         self, before: Dict[str, int], wall_start: float, statements: int

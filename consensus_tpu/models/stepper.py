@@ -41,6 +41,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from consensus_tpu.models.config import ModelConfig
 from consensus_tpu.models.generate import left_pad_positions
@@ -396,7 +397,7 @@ def rollout_scored(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "n_roles", "suffix_len", "depth"),
+    static_argnames=("config", "n_roles", "suffix_len", "depth", "mesh"),
 )
 def rollout_scored_many(
     params,
@@ -411,6 +412,7 @@ def rollout_scored_many(
     base_key: jax.Array,  # (2,)
     temperature: jax.Array,
     eos_ids: jax.Array,  # (E,) int32
+    mesh: Optional[Mesh] = None,  # static: shard rollout paths over data
 ) -> jax.Array:
     """A whole WAVE of MCTS rollouts in ONE device call: ``P`` equal-length
     tree paths each continue ``depth`` reference-policy tokens past
@@ -433,6 +435,8 @@ def rollout_scored_many(
     c = config
     n_paths = suffix_tokens.shape[0]
     rows = n_paths * n_roles
+    suffix_tokens = _constrain(suffix_tokens, mesh, "data", None)
+    salts = _constrain(salts, mesh, "data")
     scratch, _ = _scratch_cache(state, t_filled, extra=0)
     hidden, suf_k, suf_v = forward_shared_trunk(
         params, config, suffix_tokens, scratch, state.cur_pos,
@@ -522,7 +526,7 @@ def rollout_scored_many(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "n_roles", "suffix_len", "depth"),
+    static_argnames=("config", "n_roles", "suffix_len", "depth", "mesh"),
 )
 def rollout_verify_many(
     params,
@@ -538,6 +542,7 @@ def rollout_verify_many(
     base_key: jax.Array,  # (2,)
     temperature: jax.Array,
     eos_ids: jax.Array,  # (E,) int32
+    mesh: Optional[Mesh] = None,  # static: shard verify paths over data
 ) -> jax.Array:
     """Speculative verification of whole rollout drafts in ONE parallel
     forward (Leviathan et al.: draft cheap, verify wide).  Teacher-forces
@@ -565,6 +570,9 @@ def rollout_verify_many(
     on tiny models in tests/test_speculative.py.  The session state is
     untouched."""
     n_paths = suffix_tokens.shape[0]
+    suffix_tokens = _constrain(suffix_tokens, mesh, "data", None)
+    draft_tokens = _constrain(draft_tokens, mesh, "data", None)
+    salts = _constrain(salts, mesh, "data")
     scratch, _ = _scratch_cache(state, t_filled, extra=0)
     ext = jnp.concatenate([suffix_tokens, draft_tokens], axis=1)
     hidden = forward_shared_trunk(
@@ -639,12 +647,60 @@ class PagedSlotState(NamedTuple):
     v_pages: jax.Array
 
 
+def _constrain(x: jax.Array, mesh: Optional[Mesh], *axes) -> jax.Array:
+    """``with_sharding_constraint`` under a ``(data, model)`` mesh; identity
+    when no mesh is in play.  A mesh axis is silently dropped for any array
+    dim it does not divide (e.g. kv-heads < tp, or a slot count that is not
+    a multiple of dp) — the dim stays replicated rather than erroring, so
+    one program text serves every (dp, tp) width.  Axis names are string
+    literals ("data"/"model") to keep this module import-cycle-free from
+    ``consensus_tpu.parallel``."""
+    if mesh is None:
+        return x
+    resolved = tuple(
+        axis if axis is not None and dim % mesh.shape[axis] == 0 else None
+        for dim, axis in zip(x.shape, axes)
+    )
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*resolved))
+    )
+
+
+def _constrain_state(
+    state: PagedSlotState, mesh: Optional[Mesh]
+) -> PagedSlotState:
+    """Page pool sharding: kv-head axis over ``model`` (Megatron attention
+    shards heads, so each model shard holds its own heads' pages), all other
+    axes replicated — pages are addressed by slot block tables host-side,
+    never by a device axis."""
+    if mesh is None:
+        return state
+    return PagedSlotState(
+        _constrain(state.k_pages, mesh, None, None, None, "model", None),
+        _constrain(state.v_pages, mesh, None, None, None, "model", None),
+    )
+
+
 def make_page_state(
-    config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.float32
+    config: ModelConfig,
+    num_pages: int,
+    page_size: int,
+    dtype=jnp.float32,
+    mesh: Optional[Mesh] = None,
 ) -> PagedSlotState:
     c = config
     shape = (c.n_layers, num_pages + 1, page_size, c.n_kv_heads, c.head_dim)
-    return PagedSlotState(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+    state = PagedSlotState(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+    if mesh is not None:
+        kv_axis = "model" if c.n_kv_heads % mesh.shape["model"] == 0 else None
+        sharding = NamedSharding(
+            mesh, PartitionSpec(None, None, None, kv_axis, None)
+        )
+        state = PagedSlotState(
+            jax.device_put(state.k_pages, sharding),
+            jax.device_put(state.v_pages, sharding),
+        )
+    return state
 
 
 def _paged_forward(
@@ -723,7 +779,7 @@ def _paged_forward(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config",), donate_argnums=(4,)
+    jax.jit, static_argnames=("config", "mesh"), donate_argnums=(4,)
 )
 def paged_prefill_chunk(
     params,
@@ -735,6 +791,7 @@ def paged_prefill_chunk(
     lengths: jax.Array,  # (B,) int32 — stream length AFTER this chunk
     write_pages: jax.Array,  # (B, C)
     write_offsets: jax.Array,  # (B, C)
+    mesh: Optional[Mesh] = None,  # static: shard slots over data, KV over model
 ) -> Tuple[jax.Array, PagedSlotState]:
     """Ingest one prompt chunk per slot into the page pool.
 
@@ -747,6 +804,13 @@ def paged_prefill_chunk(
     complete — and the updated page state.
     """
     b, chunk = tokens.shape
+    tokens = _constrain(tokens, mesh, "data", None)
+    chunk_valid = _constrain(chunk_valid, mesh, "data", None)
+    block_tables = _constrain(block_tables, mesh, "data", None)
+    lengths = _constrain(lengths, mesh, "data")
+    write_pages = _constrain(write_pages, mesh, "data", None)
+    write_offsets = _constrain(write_offsets, mesh, "data", None)
+    state = _constrain_state(state, mesh)
     n_valid = jnp.sum(chunk_valid.astype(jnp.int32), axis=1)  # (B,)
     start = lengths - n_valid
     positions = start[:, None] + jnp.arange(chunk, dtype=jnp.int32)[None, :]
@@ -754,15 +818,16 @@ def paged_prefill_chunk(
         params, config, tokens, positions, state,
         block_tables, lengths, write_pages, write_offsets,
     )
+    state = _constrain_state(state, mesh)
     last = jnp.maximum(n_valid - 1, 0)
     hidden_last = jnp.take_along_axis(
         hidden, last[:, None, None], axis=1
     )[:, 0, :]
-    return hidden_last, state
+    return _constrain(hidden_last, mesh, "data", None), state
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config",), donate_argnums=(3,)
+    jax.jit, static_argnames=("config", "mesh"), donate_argnums=(3,)
 )
 def paged_decode_step(
     params,
@@ -773,22 +838,32 @@ def paged_decode_step(
     lengths: jax.Array,  # (B,) int32 — stream length INCLUDING this token
     write_pages: jax.Array,  # (B,) int32 — sink page for inactive slots
     write_offsets: jax.Array,  # (B,) int32
+    mesh: Optional[Mesh] = None,  # static: shard slots over data, KV over model
 ) -> Tuple[jax.Array, PagedSlotState]:
     """One decode iteration for the whole slot table: every active slot
     advances one position, reading K/V through its own block table.  One
     compiled shape regardless of slot lengths.  Returns (logits (B, V)
-    f32, updated page state)."""
+    f32, updated page state); under a mesh the logits come out sharded
+    (slots over ``data``, vocab over ``model`` — the embedding's row shards
+    produce vocab-sharded logits and argmax reductions ride ICI)."""
+    tokens = _constrain(tokens, mesh, "data")
+    block_tables = _constrain(block_tables, mesh, "data", None)
+    lengths = _constrain(lengths, mesh, "data")
+    write_pages = _constrain(write_pages, mesh, "data")
+    write_offsets = _constrain(write_offsets, mesh, "data")
+    state = _constrain_state(state, mesh)
     positions = (lengths - 1)[:, None]
     hidden, state = _paged_forward(
         params, config, tokens[:, None], positions, state,
         block_tables, lengths, write_pages[:, None], write_offsets[:, None],
     )
+    state = _constrain_state(state, mesh)
     logits = project_logits(params, config, hidden[:, 0, :])
-    return logits, state
+    return _constrain(logits, mesh, "data", "model"), state
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config",), donate_argnums=(3,)
+    jax.jit, static_argnames=("config", "mesh"), donate_argnums=(3,)
 )
 def paged_gather_step(
     params,
@@ -797,6 +872,7 @@ def paged_gather_step(
     state: PagedSlotState,
     block_tables: jax.Array,  # (B, max_blocks) — may name SHARED pages
     lengths: jax.Array,  # (B,) int32 — cached stream length
+    mesh: Optional[Mesh] = None,  # static: shard slots over data, KV over model
 ) -> Tuple[jax.Array, PagedSlotState]:
     """Read-only decode step over shared prefix pages (the prefix cache's
     gather path).  When a slot adopts a fully cached prompt it still needs
@@ -812,11 +888,16 @@ def paged_gather_step(
     the sink page changed."""
     num_pages = state.k_pages.shape[1] - 1
     b = tokens.shape[0]
+    tokens = _constrain(tokens, mesh, "data")
+    block_tables = _constrain(block_tables, mesh, "data", None)
+    lengths = _constrain(lengths, mesh, "data")
+    state = _constrain_state(state, mesh)
     sink = jnp.full((b, 1), num_pages, jnp.int32)
     positions = (lengths - 1)[:, None]
     hidden, state = _paged_forward(
         params, config, tokens[:, None], positions, state,
         block_tables, lengths, sink, jnp.zeros((b, 1), jnp.int32),
     )
+    state = _constrain_state(state, mesh)
     logits = project_logits(params, config, hidden[:, 0, :])
-    return logits, state
+    return _constrain(logits, mesh, "data", "model"), state

@@ -5,23 +5,34 @@ is a thread pool around HTTP calls (src/experiment.py:283-322; SURVEY §2.16).
 This package is the TPU-native replacement: a `jax.sharding.Mesh` over ICI
 with data-parallel batch axes and tensor-parallel model axes, XLA inserting
 the collectives.
+
+The *serving* path (TPUBackend → DecodeEngine → serve/fleet) consumes the
+mesh in production via ``mesh={'dp': N, 'tp': M}`` plumbing; ``train.py``
+remains dryrun-only scaffolding (exercised by ``__graft_entry__`` smoke
+paths, never by the serving stack).
 """
 
 from consensus_tpu.parallel.mesh import (
+    PARTITION_RULES,
     MeshPlan,
     batch_sharding,
     make_mesh,
+    match_partition_rules,
     param_shardings,
+    parse_mesh_spec,
     shard_batch,
     shard_params,
 )
 from consensus_tpu.parallel.train import train_step, init_train_state, lm_loss
 
 __all__ = [
+    "PARTITION_RULES",
     "MeshPlan",
     "batch_sharding",
     "make_mesh",
+    "match_partition_rules",
     "param_shardings",
+    "parse_mesh_spec",
     "shard_batch",
     "shard_params",
     "train_step",

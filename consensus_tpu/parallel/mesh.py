@@ -22,7 +22,8 @@ thread pool over HTTP calls (src/experiment.py:283-322).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Sequence
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -68,32 +69,83 @@ def make_mesh(
     return MeshPlan(mesh=Mesh(grid, (DATA_AXIS, MODEL_AXIS)), dp=dp, tp=tp)
 
 
-#: PartitionSpec per parameter leaf. Layer-stacked leaves carry a leading
-#: layer axis (never sharded — it is scanned over).
-_LAYER_SPECS: Dict[str, P] = {
-    "attn_norm": P(None, None),
-    "ffn_norm": P(None, None),
-    "post_attn_norm": P(None, None),
-    "post_ffn_norm": P(None, None),
+#: Regex partition rules (match_partition_rules style): first rule whose
+#: pattern ``re.search``-matches a ``/``-joined param path wins.  Layer-
+#: stacked leaves carry a leading layer axis (never sharded — it is scanned
+#: over).  Every param path of every supported model family (gemma2 AND
+#: llama3 tiers) must match a rule: :func:`match_partition_rules` raises on
+#: any unmatched path, so a new param added to the runtime without a layout
+#: decision fails loudly instead of silently replicating (pinned in
+#: tests/test_mesh_serving.py against both tiny models).
+PARTITION_RULES: Tuple[Tuple[str, P], ...] = (
+    # Norm vectors replicate (tiny; every shard needs them whole).
+    (r"^layers/(attn_norm|ffn_norm|post_attn_norm|post_ffn_norm)$",
+     P(None, None)),
     # (L, D, H*hd): split heads (output features) over model.
-    "wq": P(None, None, MODEL_AXIS),
-    "wk": P(None, None, MODEL_AXIS),
-    "wv": P(None, None, MODEL_AXIS),
+    (r"^layers/(wq|wk|wv)$", P(None, None, MODEL_AXIS)),
     # (L, H*hd, D): split input features — contraction psum follows.
-    "wo": P(None, MODEL_AXIS, None),
+    (r"^layers/wo$", P(None, MODEL_AXIS, None)),
     # (L, D, F): split hidden features.
-    "w_gate": P(None, None, MODEL_AXIS),
-    "w_up": P(None, None, MODEL_AXIS),
+    (r"^layers/(w_gate|w_up)$", P(None, None, MODEL_AXIS)),
     # (L, F, D): split input features.
-    "w_down": P(None, MODEL_AXIS, None),
-}
+    (r"^layers/w_down$", P(None, MODEL_AXIS, None)),
+    # (V, D): shard vocab rows; logits come out sharded over vocab.
+    (r"^(embed|lm_head)$", P(MODEL_AXIS, None)),
+    (r"^final_norm$", P(None)),
+)
 
-_TOP_SPECS: Dict[str, P] = {
-    # (V, D): shard vocab rows.
-    "embed": P(MODEL_AXIS, None),
-    "lm_head": P(MODEL_AXIS, None),
-    "final_norm": P(None),
-}
+
+def _iter_param_paths(params: Dict[str, Any], prefix: str = ""):
+    """Yield (``/``-joined path, leaf) pairs for a runtime param pytree.
+    QTensor leaves (int8 weight + scale) count as ONE leaf — their layout
+    derives from the full-precision weight's spec in :func:`_leaf_sharding`."""
+    for name, value in params.items():
+        path = f"{prefix}{name}"
+        if isinstance(value, dict):
+            yield from _iter_param_paths(value, path + "/")
+        else:
+            yield path, value
+
+
+def match_partition_rules(
+    params: Dict[str, Any],
+    rules: Sequence[Tuple[str, P]] = PARTITION_RULES,
+) -> Dict[str, P]:
+    """PartitionSpec pytree for ``params`` from regex rules (SNIPPETS [3]).
+
+    Returns the same nested-dict structure with a PartitionSpec per leaf.
+    Scalars and single-element leaves are never partitioned (``P()``).
+    Raises ``ValueError`` naming EVERY unmatched path — the coverage check
+    the mesh serving tests pin, so partial layouts can't ship silently.
+    """
+    specs: Dict[str, Any] = {}
+    unmatched: List[str] = []
+    for path, leaf in _iter_param_paths(params):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:  # int8 QTensor: layout follows the quantized weight
+            shape = getattr(getattr(leaf, "q", None), "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            spec = P()  # scalars never partition
+        else:
+            for pattern, rule_spec in rules:
+                if re.search(pattern, path) is not None:
+                    spec = rule_spec
+                    break
+            else:
+                unmatched.append(path)
+                continue
+        node = specs
+        parts = path.split("/")
+        for key in parts[:-1]:
+            node = node.setdefault(key, {})
+        node[parts[-1]] = spec
+    if unmatched:
+        raise ValueError(
+            "no partition rule matches param path(s): "
+            + ", ".join(sorted(unmatched))
+            + " — add a rule to consensus_tpu.parallel.mesh.PARTITION_RULES"
+        )
+    return specs
 
 
 def _leaf_sharding(leaf: Any, spec: P, mesh: Mesh) -> Any:
@@ -126,17 +178,53 @@ def _leaf_sharding(leaf: Any, spec: P, mesh: Mesh) -> Any:
 
 def param_shardings(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
     """NamedSharding pytree matching a runtime param pytree (full-precision
-    or int8-quantized leaves)."""
+    or int8-quantized leaves), resolved through :data:`PARTITION_RULES` —
+    an unmatched param path raises rather than silently replicating."""
+    specs = match_partition_rules(params)
 
-    def top(name: str, value):
-        if name == "layers":
-            return {
-                k: _leaf_sharding(v, _LAYER_SPECS.get(k, P()), mesh)
-                for k, v in value.items()
-            }
-        return _leaf_sharding(value, _TOP_SPECS.get(name, P()), mesh)
+    def resolve(value, spec):
+        if isinstance(value, dict):
+            return {k: resolve(v, spec[k]) for k, v in value.items()}
+        return _leaf_sharding(value, spec, mesh)
 
-    return {name: top(name, value) for name, value in params.items()}
+    return {name: resolve(value, specs[name]) for name, value in params.items()}
+
+
+def parse_mesh_spec(
+    spec: Union[str, Dict[str, int], MeshPlan, None],
+) -> Optional[Dict[str, int]]:
+    """Normalise a mesh request to ``{"dp": N, "tp": M}``.
+
+    Accepts the CLI string form (``"dp=4,tp=2"``, either key optional), a
+    dict with ``dp``/``tp`` keys, an existing :class:`MeshPlan`, or ``None``
+    (no mesh).  Unknown keys and non-positive sizes raise.
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, MeshPlan):
+        return {"dp": spec.dp, "tp": spec.tp}
+    if isinstance(spec, str):
+        parsed: Dict[str, int] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, value = part.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"bad mesh spec {spec!r}: expected 'dp=N,tp=M', got {part!r}"
+                )
+            parsed[key.strip()] = int(value)
+        spec = parsed
+    unknown = set(spec) - {"dp", "tp"}
+    if unknown:
+        raise ValueError(
+            f"bad mesh spec: unknown axis {sorted(unknown)} (want dp/tp)"
+        )
+    out = {"dp": int(spec.get("dp", 1)), "tp": int(spec.get("tp", 1))}
+    if out["dp"] < 1 or out["tp"] < 1:
+        raise ValueError(f"bad mesh spec: sizes must be >= 1, got {out}")
+    return out
 
 
 def shard_params(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:

@@ -53,6 +53,7 @@ def create_server(
     engine_options=None,
     fleet_size: int = 1,
     fleet_options=None,
+    mesh=None,
 ) -> ConsensusServer:
     """Wire backend → service → scheduler → HTTP server (not yet started).
 
@@ -90,9 +91,23 @@ def create_server(
     responses stay byte-identical to that path (pinned in
     tests/test_fleet.py).
 
+    ``mesh`` (``"dp=4,tp=2"`` or ``{'dp': 4, 'tp': 2}``) makes the device
+    mesh the serving path: TPU backends are built sharded over the
+    ``(data, model)`` mesh and the decode engine partitions its slot table
+    and page pools over the dp replicas (``--mesh`` on the CLI).  Non-TPU
+    backends only see the engine-side partitioning.
+
     Defaults OFF so a quiet server's responses stay byte-identical to
     offline Experiment runs (pinned in tests/test_serve.py)."""
     from consensus_tpu.backends import get_backend, wrap_backend
+
+    if mesh is not None:
+        from consensus_tpu.parallel.mesh import parse_mesh_spec
+
+        mesh = parse_mesh_spec(mesh)
+        if backend == "tpu":
+            backend_options = {"mesh": mesh, **dict(backend_options or {})}
+        engine_options = {"mesh": mesh, **dict(engine_options or {})}
 
     if fleet_size > 1 or fleet_options:
         return _create_fleet_server(

@@ -80,6 +80,11 @@ def main(argv=None) -> int:
                              "probe_timeout_s, engine (per-replica list — "
                              "legacy flush vs --engine is chosen per "
                              "replica), ... (see create_server docs)")
+    parser.add_argument("--mesh", default=None, metavar="dp=N,tp=M",
+                        help="serve over the (data, model) device mesh: "
+                             "shard TPU backend params Megatron-style over "
+                             "tp and partition the decode engine's slots + "
+                             "page pools over dp (e.g. --mesh dp=4,tp=2)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -107,6 +112,7 @@ def main(argv=None) -> int:
         engine_options=json.loads(args.engine_options),
         fleet_size=args.fleet,
         fleet_options=json.loads(args.fleet_options) or None,
+        mesh=args.mesh,
     )
     stop = threading.Event()
 
@@ -128,6 +134,7 @@ def main(argv=None) -> int:
         "brownout": args.brownout or args.target_p95_ms is not None,
         "engine": args.engine,
         "fleet": args.fleet,
+        "mesh": args.mesh,
     }))
     try:
         stop.wait()

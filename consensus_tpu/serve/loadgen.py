@@ -274,6 +274,16 @@ def run_loadgen(
         report["failover_fraction"] = (
             round(failovers / len(ok), 4) if ok else 0.0
         )
+    mesh_stats = fetch_engine_mesh(base_url)
+    if mesh_stats is not None:
+        # Per-dp-shard slot occupancy at run end: under a balanced engine
+        # the shards should read near-equal — a skewed vector here is the
+        # loadgen-visible signature of admission imbalance.
+        report["mesh"] = {"dp": mesh_stats["dp"], "tp": mesh_stats["tp"]}
+        report["dp_shard_slot_occupancy"] = [
+            shard.get("slots_occupied", 0)
+            for shard in mesh_stats.get("per_shard", [])
+        ]
     prefix_after = fetch_prefix_stats(base_url)
     if prefix_after is not None:
         # Prefix-cache effectiveness over THIS run: admission hit/miss
@@ -344,6 +354,32 @@ def fetch_prefix_stats(base_url: str) -> Optional[Dict[str, float]]:
                 "tokens_saved"):
         totals[key] = sum(b.get(key, 0) for b in blocks)
     return totals
+
+
+def fetch_engine_mesh(base_url: str) -> Optional[Dict[str, Any]]:
+    """The ``mesh`` block of the scheduler's engine stats in /healthz
+    (dp/tp widths + per-dp-shard slot and page occupancy); None when the
+    server runs no decode engine (or /healthz is down).  Fleet mode sums
+    nothing — the first replica's engine block is representative, since
+    every replica serves the same mesh shape."""
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/healthz", timeout=5.0
+        ) as response:
+            health = json.loads(response.read().decode("utf-8"))
+    except Exception:
+        return None
+    engine = health.get("engine")
+    if isinstance(engine, dict) and isinstance(engine.get("mesh"), dict):
+        return dict(engine["mesh"])
+    fleet = health.get("fleet")
+    if isinstance(fleet, dict):
+        for snap in (fleet.get("replicas") or {}).values():
+            if isinstance(snap, dict) and isinstance(snap.get("engine"), dict):
+                mesh = snap["engine"].get("mesh")
+                if isinstance(mesh, dict):
+                    return dict(mesh)
+    return None
 
 
 def fetch_tier_counts(base_url: str) -> Optional[Dict[str, int]]:

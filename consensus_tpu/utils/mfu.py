@@ -36,5 +36,11 @@ def useful_tflops_per_sec(n_params: int, tokens: int, wall_s: float) -> float:
     return 2.0 * n_params * tokens / wall_s / 1e12
 
 
-def pct_of_peak(tflops: float, peak: float = V5E_BF16_PEAK_TFLOPS) -> float:
-    return 100.0 * tflops / peak
+def pct_of_peak(
+    tflops: float, peak: float = V5E_BF16_PEAK_TFLOPS, n_devices: int = 1
+) -> float:
+    """Percent of aggregate peak.  ``n_devices`` scales the denominator to
+    the mesh: a dp=4,tp=2 slice has 8 chips' worth of peak FLOPs, and
+    quoting a multichip run against one chip's peak would flatter the
+    number 8x.  Single-chip callers (the default) are unchanged."""
+    return 100.0 * tflops / (peak * max(1, int(n_devices)))

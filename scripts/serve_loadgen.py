@@ -69,6 +69,11 @@ def main(argv=None) -> int:
                         help="(self-contained) JSON object of extra "
                              "DecodeEngine options (slots, num_pages, "
                              "prefix_cache_pages, ...)")
+    parser.add_argument("--mesh", default=None, metavar="dp=N,tp=M",
+                        help="(self-contained) serve over the (data, model) "
+                             "device mesh: the decode engine partitions its "
+                             "slots + page pools over dp (implies --engine) "
+                             "and the report gains dp_shard_slot_occupancy")
     parser.add_argument("--brownout", action="store_true",
                         help="(self-contained) enable the brownout "
                              "controller: overloaded requests run at a "
@@ -139,10 +144,12 @@ def main(argv=None) -> int:
             fault_plan=args.fault_plan,
             brownout=args.brownout or args.target_p95_ms is not None,
             target_p95_ms=args.target_p95_ms,
-            engine=args.engine or args.prefix_cache or bool(engine_options),
+            engine=args.engine or args.prefix_cache or bool(engine_options)
+            or args.mesh is not None,
             engine_options=engine_options or None,
             fleet_size=args.fleet,
             fleet_options=json.loads(args.fleet_options) or None,
+            mesh=args.mesh,
         ).start()
         killer = None
         if args.kill_replica_at_s is not None:
