@@ -150,6 +150,15 @@ class BatchingBackend:
             "wakeup per other kind's dispatch.",
             labels=("kind",),
         )
+        #: Shares the engine's dedup family: identical score rows merged
+        #: into one flush are computed once regardless of which dispatch
+        #: loop (engine iteration or legacy flush-snapshot) runs them.
+        self._score_dedup = reg.counter(
+            "engine_score_dedup_total",
+            "Duplicate score rows removed from merged dispatches — "
+            "identical (prompt, continuation) rows in one flush are "
+            "computed once and fanned back out.",
+        )
         #: Until this many sessions have STARTED, the all-blocked heuristic
         #: is suppressed — otherwise the first worker to enqueue during pool
         #: ramp-up sees active==1 and flushes a batch of one.
@@ -171,6 +180,7 @@ class BatchingBackend:
         self._flushing = False
         self._queues: Dict[str, List[_Pending]] = {
             "generate": [], "score": [], "next_token": [], "embed": [],
+            "score_matrix": [],
         }
         self._conds: Dict[str, threading.Condition] = {
             kind: threading.Condition(self._lock) for kind in self._queues
@@ -180,7 +190,10 @@ class BatchingBackend:
         }
         #: Device batches actually issued per kind — the measurable win:
         #: N concurrent runs << N× the solo batch count.
-        self.batch_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+        self.batch_counts = {
+            "generate": 0, "score": 0, "next_token": 0, "embed": 0,
+            "score_matrix": 0,
+        }
         #: Per-thread session cancellation probe (set by ``session()``).
         self._tls = threading.local()
         #: Continuous-batching engine (backends/engine.py): when enabled,
@@ -284,6 +297,21 @@ class BatchingBackend:
 
     def score(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
         return self._call("score", list(requests), self.inner.score)
+
+    def score_matrix(self, requests: Sequence[Any]) -> List[Any]:
+        """(candidates x agents) utility matrices through the batching seam:
+        engine mode merges co-batched sessions' matrices into one
+        iteration-loop dispatch; the legacy flush path queues them like any
+        other kind and routes to the inner backend's fused path (or the
+        exact per-call fallback for backends without one)."""
+        return self._call(
+            "score_matrix", list(requests), self._score_matrix_inner
+        )
+
+    def _score_matrix_inner(self, requests: List[Any]) -> List[Any]:
+        from consensus_tpu.backends.score_matrix import score_matrix_many
+
+        return score_matrix_many(self.inner, requests)
 
     def next_token_logprobs(
         self, requests: Sequence[NextTokenRequest]
@@ -487,6 +515,7 @@ class BatchingBackend:
             ("score", self.inner.score),
             ("next_token", self.inner.next_token_logprobs),
             ("embed", self.inner.embed),
+            ("score_matrix", self._score_matrix_inner),
         ):
             queue = snapshot[kind]
             if not queue:
@@ -500,8 +529,27 @@ class BatchingBackend:
             self._batch_fill.labels(kind).observe(len(queue))
             self._merged_requests.labels(kind).inc(len(merged))
             self.batch_counts[kind] += 1
+            # Identical score rows across co-batched sessions (beam rounds
+            # re-scoring shared prefixes, matrix fallbacks repeating agent
+            # rows) compute once and fan back out.
+            dispatch = merged
+            mapping = None
+            if kind == "score":
+                from consensus_tpu.backends.score_matrix import (
+                    dedup_score_requests,
+                )
+
+                dispatch, mapping = dedup_score_requests(merged)
+                if len(dispatch) < len(merged):
+                    self._score_dedup.inc(len(merged) - len(dispatch))
             try:
-                results = fn(merged)
+                results = fn(dispatch)
+                if mapping is not None:
+                    from consensus_tpu.backends.score_matrix import (
+                        expand_deduped,
+                    )
+
+                    results = expand_deduped(results, mapping)
                 cursor = 0
                 for entry in queue:
                     n = len(entry.requests)
@@ -516,6 +564,12 @@ class BatchingBackend:
                 # a waiter whose rows all survived gets its slice; a waiter
                 # owning a failed row gets that row's typed error — one bad
                 # row fails one session's call, not the whole device batch.
+                if mapping is not None:
+                    from consensus_tpu.backends.score_matrix import (
+                        expand_partial_error,
+                    )
+
+                    exc = expand_partial_error(exc, mapping)
                 self._distribute_partial(kind, queue, exc)
             except Exception as exc:  # fail every waiter in this batch
                 for entry in queue:

@@ -309,6 +309,12 @@ class DecodeEngine:
             "prefix_tokens_saved_total",
             "Prompt tokens whose prefill was skipped via a cached prefix.",
         )
+        self._m_score_dedup = reg.counter(
+            "engine_score_dedup_total",
+            "Duplicate score rows removed from merged dispatches — "
+            "identical (prompt, continuation) rows in one flush are "
+            "computed once and fanned back out.",
+        )
         #: Queued-call cancellations share the batching adapter's counter
         #: family so PR 1 dashboards keep one cancellation series.
         self._cancelled_counter = cancelled_counter
@@ -317,13 +323,14 @@ class DecodeEngine:
         #: ``batch_counts`` to this dict so serve stats keep working.
         self.dispatch_counts = {
             "generate": 0, "score": 0, "next_token": 0, "embed": 0,
+            "score_matrix": 0,
         }
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._gen_backlog: List[_Row] = []
         self._other: Dict[str, List[_Item]] = {
-            "score": [], "next_token": [], "embed": [],
+            "score": [], "next_token": [], "embed": [], "score_matrix": [],
         }
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
         #: Per-dp-shard page reservations (index = shard); the legacy
@@ -706,13 +713,33 @@ class DecodeEngine:
             "score": self.inner.score,
             "next_token": self.inner.next_token_logprobs,
             "embed": self.inner.embed,
+            "score_matrix": self._inner_score_matrix,
         }[kind]
         merged: List[Any] = []
         for item in items:
             merged.extend(item.requests)
+        # Identical score rows in one merged dispatch compute once and fan
+        # out (beam search re-scores shared prefixes every round; matrix
+        # fallbacks repeat agent rows across co-batched sessions).
+        mapping: Optional[List[int]] = None
+        dispatch = merged
+        if kind == "score":
+            from consensus_tpu.backends.score_matrix import dedup_score_requests
+
+            unique, mapping = dedup_score_requests(merged)
+            if len(unique) < len(merged):
+                self._m_score_dedup.inc(len(merged) - len(unique))
+            dispatch = unique
+        reserved = 0
+        if kind == "score_matrix":
+            reserved = self._reserve_matrix_pages(merged)
         self.dispatch_counts[kind] += 1
         try:
-            results = fn(merged)
+            results = fn(dispatch)
+            if mapping is not None:
+                from consensus_tpu.backends.score_matrix import expand_deduped
+
+                results = expand_deduped(results, mapping)
             cursor = 0
             for item in items:
                 n = len(item.requests)
@@ -720,6 +747,12 @@ class DecodeEngine:
                 cursor += n
                 item.event.set()
         except PartialBatchError as exc:
+            if mapping is not None:
+                from consensus_tpu.backends.score_matrix import (
+                    expand_partial_error,
+                )
+
+                exc = expand_partial_error(exc, mapping)
             cursor = 0
             for item in items:
                 n = len(item.requests)
@@ -748,7 +781,46 @@ class DecodeEngine:
                 item.error = exc
                 item.event.set()
         with self._lock:
+            if reserved:
+                self._reserved[0] -= reserved
             self._work.notify_all()
+
+    def _inner_score_matrix(self, requests: List[Any]) -> List[Any]:
+        """Route matrix requests to the inner backend's fused path when it
+        has one, else the exact per-call fallback (one batched score)."""
+        from consensus_tpu.backends.score_matrix import score_matrix_many
+
+        return score_matrix_many(self.inner, requests)
+
+    def _reserve_matrix_pages(self, requests: List[Any]) -> int:
+        """Advisory page accounting for a matrix dispatch: the fused path
+        allocates its own page pool on the same device, so reserving its
+        estimated footprint against shard 0 makes generate admission back
+        off instead of OOMing alongside it.  Estimates use the accounting
+        tokenizer (never numerics); clamped so a huge matrix cannot wedge
+        admission entirely."""
+        ps = self.pool.page_size
+        pages = 0
+        for request in requests:
+            cont = [self._count_text_tokens(c) for c in request.candidates]
+            max_cont = max(cont, default=0)
+            seen = set()
+            for agent in request.agents:
+                key = (agent.context, agent.system_prompt)
+                if key in seen:
+                    continue
+                seen.add(key)
+                n_ctx = self._count_text_tokens(agent.context)
+                if agent.system_prompt:
+                    n_ctx += self._count_text_tokens(agent.system_prompt)
+                pages += n_ctx // ps
+            rows = min(len(request.candidates) * len(request.agents), 64)
+            pages += rows * ((ps + max_cont) // ps + 1)
+        pages = min(pages, self.pool.num_pages // 2)
+        if pages:
+            with self._lock:
+                self._reserved[0] += pages
+        return pages
 
     # -- bookkeeping (lock held) --------------------------------------------
 

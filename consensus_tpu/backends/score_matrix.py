@@ -1,0 +1,334 @@
+"""The (candidates x agents) utility-matrix scoring seam.
+
+The paper's objective is intrinsically a matrix: every candidate statement
+is scored under every agent's opinion context and a welfare rule reduces
+the agent axis.  Before this seam existed, each (candidate, agent) cell
+was a separate :class:`~consensus_tpu.backends.base.ScoreRequest` whose
+full per-token logprob vector crossed D2H before host Python reduced it.
+This module defines the batch-first protocol that lets backends evaluate
+the whole matrix in one device program (``TPUBackend.score_matrix``) and
+provides an exact host-side fallback for backends that cannot
+(:func:`fallback_score_matrix_many`).
+
+Identity contract
+-----------------
+
+The fallback builds *precisely* the per-call ``ScoreRequest`` rows that
+today's consumers (best-of-N, beam sessions, the evaluator) build, issues
+ONE batched ``backend.score`` call, and reduces each cell with the same
+expressions the consumers used (``ScoreResult.mean``, ``sum(logprobs)``,
+``logprobs[-1]``, the evaluator's float64 moments) — so switching a
+consumer to the matrix seam over a fallback backend is byte-identical,
+and the fused device path agrees to float tolerance with the same argmax
+under pinned (numpy first-max) tie-breaking.
+
+Per-cell statistics (``stat``):
+
+* ``"mean"``    — ``ScoreResult.mean(default)`` (best-of-N, evaluator's
+  scalar utility).
+* ``"sum"``     — ``float(sum(logprobs))`` — the *sequential* Python sum
+  the search sessions use for rollout returns (NOT ``np.sum``; pairwise
+  summation rounds differently on long sequences).
+* ``"last"``    — ``logprobs[-1]`` (token-search proposal scoring).
+* ``"moments"`` — ``(mean logprob, mean prob)`` in float64, the
+  evaluator's perplexity accounting; ``aux`` carries the mean prob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from consensus_tpu.backends.base import (
+    PartialBatchError,
+    ScoreRequest,
+    ScoreResult,
+)
+from consensus_tpu.ops.welfare import (
+    DEFAULT_REWARD,
+    WELFARE_RULES,
+    sanitize_utilities,
+)
+
+_STATS = ("mean", "sum", "last", "moments")
+
+
+@dataclasses.dataclass(frozen=True)
+class AgentContext:
+    """One agent's scoring context — the ScoreRequest fields minus the
+    continuation, so a matrix request can cross A contexts with C
+    candidates without materializing C*A strings."""
+
+    context: str
+    system_prompt: Optional[str] = None
+    chat: bool = True
+    role: str = "assistant"
+
+    def to_score_request(self, continuation: str) -> ScoreRequest:
+        return ScoreRequest(
+            context=self.context,
+            continuation=continuation,
+            system_prompt=self.system_prompt,
+            chat=self.chat,
+            role=self.role,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoreMatrixRequest:
+    """Score every candidate under every agent context in one call."""
+
+    agents: Tuple[AgentContext, ...]
+    candidates: Tuple[str, ...]
+    stat: str = "mean"
+    welfare_rule: str = "egalitarian"
+    default: float = DEFAULT_REWARD
+
+    def __post_init__(self) -> None:
+        if self.stat not in _STATS:
+            raise ValueError(f"unknown stat {self.stat!r}; want one of {_STATS}")
+        if self.welfare_rule not in WELFARE_RULES:
+            raise ValueError(
+                f"unknown welfare rule {self.welfare_rule!r}; "
+                f"want one of {tuple(WELFARE_RULES)}"
+            )
+
+    def cell_requests(self) -> List[ScoreRequest]:
+        """The per-call rows this matrix replaces, in (candidate-major,
+        agent-minor) order — the order every adopting consumer used."""
+        return [
+            agent.to_score_request(candidate)
+            for candidate in self.candidates
+            for agent in self.agents
+        ]
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ScoreMatrixResult:
+    """(C, A) utilities + the on-device welfare reduction.
+
+    ``utilities`` is float64 on the fallback path (exact per-call floats)
+    and f32 from the fused device path; consumers that historically cast
+    to f32 (best-of-N) keep doing so and see identical values either way.
+    ``aux`` is the second moment for ``stat="moments"`` (mean prob per
+    cell), else ``None``.  ``best`` is ``int(np.argmax(welfare))`` — numpy
+    first-max is the pinned tie-break.  ``d2h_bytes`` is what actually
+    crossed device-to-host for this matrix (the fused path ships only the
+    reductions; the fallback ships every per-token logprob and reports
+    that honestly).  ``path`` is ``"fused"`` or ``"fallback"``.
+    """
+
+    utilities: np.ndarray
+    welfare: np.ndarray
+    best: int
+    aux: Optional[np.ndarray] = None
+    cells: int = 0
+    d2h_bytes: int = 0
+    path: str = "fallback"
+
+
+def _cell_stat(result: ScoreResult, stat: str, default: float):
+    """Reduce one ScoreResult with the exact host expression the per-call
+    consumers used (see module docstring)."""
+    if stat == "mean":
+        return result.mean(default=default)
+    if stat == "sum":
+        return float(sum(result.logprobs)) if result.ok else default
+    if stat == "last":
+        return float(result.logprobs[-1]) if result.ok else default
+    # moments: the evaluator's float64 accounting (empty -> (default, 0.0))
+    lps = np.asarray(result.logprobs, dtype=np.float64)
+    avg_lp = float(lps.mean()) if lps.size else default
+    avg_p = float(np.exp(lps).mean()) if lps.size else 0.0
+    return avg_lp, avg_p
+
+
+def reduce_matrix(
+    request: ScoreMatrixRequest, results: Sequence[ScoreResult], *, path: str
+) -> ScoreMatrixResult:
+    """Fold per-cell ScoreResults into a ScoreMatrixResult (fallback path)."""
+    n_candidates = len(request.candidates)
+    n_agents = len(request.agents)
+    values: List[float] = []
+    aux_values: List[float] = []
+    d2h = 0
+    for result in results:
+        d2h += len(result.logprobs) * 8  # f64 logprobs actually shipped
+        cell = _cell_stat(result, request.stat, request.default)
+        if request.stat == "moments":
+            values.append(cell[0])
+            aux_values.append(cell[1])
+        else:
+            values.append(cell)
+    utilities = np.asarray(values, dtype=np.float64).reshape(
+        n_candidates, n_agents
+    )
+    aux = (
+        np.asarray(aux_values, dtype=np.float64).reshape(n_candidates, n_agents)
+        if request.stat == "moments"
+        else None
+    )
+    welfare_vals, best = welfare_argmax(utilities, request.welfare_rule)
+    return ScoreMatrixResult(
+        utilities=utilities,
+        welfare=welfare_vals,
+        best=best,
+        aux=aux,
+        cells=n_candidates * n_agents,
+        d2h_bytes=d2h,
+        path=path,
+    )
+
+
+def welfare_argmax(utilities: np.ndarray, rule: str) -> Tuple[np.ndarray, int]:
+    """sanitize -> welfare over the agent axis -> pinned first-max argmax.
+
+    Matches best-of-N's selection statement exactly: welfare is computed
+    on the f32-sanitized matrix and numpy's first-max breaks ties."""
+    if utilities.size == 0:
+        return np.zeros((utilities.shape[0],), dtype=np.float32), 0
+    welfare_vals = np.asarray(
+        WELFARE_RULES[rule](sanitize_utilities(utilities), axis=1)
+    )
+    return welfare_vals, int(np.argmax(welfare_vals))
+
+
+# ---------------------------------------------------------------------------
+# Score-row dedup (engine + legacy flush; satellite: beam search re-scores
+# shared prefixes every round, and matrices repeat agent rows).
+
+
+def _score_key(request: ScoreRequest):
+    return (
+        request.context,
+        request.continuation,
+        request.system_prompt,
+        request.chat,
+        request.role,
+    )
+
+
+def dedup_score_requests(
+    requests: Sequence[ScoreRequest],
+) -> Tuple[List[ScoreRequest], List[int]]:
+    """-> (unique, mapping) with ``requests[i] == unique[mapping[i]]``.
+
+    Model identity is per-backend (one inner model per dispatch loop), so
+    the key is the full request tuple; two textually identical rows score
+    identically on any deterministic backend."""
+    seen: Dict[tuple, int] = {}
+    unique: List[ScoreRequest] = []
+    mapping: List[int] = []
+    for request in requests:
+        key = _score_key(request)
+        index = seen.get(key)
+        if index is None:
+            index = len(unique)
+            seen[key] = index
+            unique.append(request)
+        mapping.append(index)
+    return unique, mapping
+
+
+def expand_deduped(values: Sequence, mapping: Sequence[int]) -> List:
+    return [values[j] for j in mapping]
+
+
+def expand_partial_error(
+    error: PartialBatchError, mapping: Sequence[int]
+) -> PartialBatchError:
+    """Re-shape a PartialBatchError over unique rows back to caller rows:
+    every caller row sharing a failed unique row fails the same way."""
+    results = (
+        expand_deduped(error.results, mapping)
+        if error.results is not None
+        else None
+    )
+    row_errors = {
+        i: error.row_errors[j]
+        for i, j in enumerate(mapping)
+        if j in error.row_errors
+    }
+    return PartialBatchError(
+        str(error) or "partial batch failure", results, row_errors
+    )
+
+
+# ---------------------------------------------------------------------------
+# Observability (families are idempotent by name across backends).
+
+
+def matrix_metrics(registry=None):
+    from consensus_tpu.obs.metrics import DEFAULT_COUNT_BUCKETS, get_registry
+
+    reg = registry if registry is not None else get_registry()
+    cells = reg.counter(
+        "score_matrix_cells_total",
+        "(candidate, agent) utility cells evaluated via the matrix seam",
+    )
+    d2h = reg.counter(
+        "score_matrix_d2h_bytes_total",
+        "bytes fetched device-to-host for matrix scoring results",
+    )
+    agents_hist = reg.histogram(
+        "score_agents_per_call",
+        "agent-axis width of score_matrix calls",
+        buckets=DEFAULT_COUNT_BUCKETS,
+    )
+    return cells, d2h, agents_hist
+
+
+def record_matrix(result: ScoreMatrixResult, n_agents: int, registry=None):
+    cells, d2h, agents_hist = matrix_metrics(registry)
+    cells.inc(result.cells)
+    d2h.inc(result.d2h_bytes)
+    agents_hist.observe(n_agents)
+
+
+# ---------------------------------------------------------------------------
+# Fallback + dispatch.
+
+
+def fallback_score_matrix_many(
+    backend, requests: Sequence[ScoreMatrixRequest]
+) -> List[ScoreMatrixResult]:
+    """Evaluate matrices through the per-call score seam: dedup identical
+    rows across ALL matrices, issue ONE batched ``backend.score`` call
+    (so session dispatch accounting is unchanged vs the per-call code it
+    replaces), fan results back out, and reduce with the exact host
+    semantics."""
+    all_rows: List[ScoreRequest] = []
+    spans: List[Tuple[int, int]] = []
+    for request in requests:
+        rows = request.cell_requests()
+        spans.append((len(all_rows), len(all_rows) + len(rows)))
+        all_rows.extend(rows)
+    if not all_rows:
+        return [
+            reduce_matrix(request, [], path="fallback") for request in requests
+        ]
+    unique, mapping = dedup_score_requests(all_rows)
+    try:
+        unique_results = backend.score(unique)
+    except PartialBatchError as exc:
+        raise expand_partial_error(exc, mapping) from None
+    results = expand_deduped(unique_results, mapping)
+    out = []
+    for request, (lo, hi) in zip(requests, spans):
+        matrix = reduce_matrix(request, results[lo:hi], path="fallback")
+        record_matrix(matrix, len(request.agents))
+        out.append(matrix)
+    return out
+
+
+def score_matrix_many(
+    backend, requests: Sequence[ScoreMatrixRequest]
+) -> List[ScoreMatrixResult]:
+    """Route to ``backend.score_matrix`` when the backend has one (fused
+    device path / engine seam), else the exact per-call fallback."""
+    fn = getattr(backend, "score_matrix", None)
+    if callable(fn):
+        return list(fn(list(requests)))
+    return fallback_score_matrix_many(backend, requests)

@@ -74,6 +74,12 @@ class SearchSpec:
     #: and ignored there (trivially byte-identical).
     speculative: bool = False
     spec_draft_len: int = 8
+    #: Route the fallback session's (prefix x candidate x agent) scoring
+    #: through the utility-matrix seam (backends/score_matrix.py): fused
+    #: on-device on backends that implement ``score_matrix``, byte-identical
+    #: batched per-call fallback elsewhere.  Off restores the flat
+    #: per-cell ``Backend.score`` batches.
+    matrix_scoring: bool = True
 
 
 class PrefixTokenSearchSession:
@@ -190,6 +196,8 @@ class PrefixTokenSearchSession:
         )
         self.dispatch_count += 1
         n_agents = len(spec.agent_prompts)
+        if getattr(spec, "matrix_scoring", True):
+            return self._rollout_totals_matrix(prefixes, results, n_agents)
         score_requests: List[ScoreRequest] = []
         starts: List[Optional[int]] = []
         for prefix, result in zip(prefixes, results):
@@ -221,6 +229,62 @@ class PrefixTokenSearchSession:
                     (sum(s.logprobs) if s.ok else spec.failure_logprob)
                     for s in row
                 ]
+                out.append((list(result.token_ids), result.text, totals, True))
+        return out
+
+    def _rollout_totals_matrix(
+        self, prefixes, results, n_agents: int
+    ) -> List[Tuple[List[int], str, List[float], bool]]:
+        """Rollout returns via the utility-matrix seam: one (1 x agents)
+        matrix per successful rollout, all submitted in ONE backend call —
+        the same dispatch count as the flat score batch it replaces, and
+        byte-identical values over the per-call fallback (stat "sum" is
+        the sequential Python sum the flat path used)."""
+        from consensus_tpu.backends.score_matrix import (
+            AgentContext,
+            ScoreMatrixRequest,
+            score_matrix_many,
+        )
+
+        spec = self.spec
+        matrix_requests: List[ScoreMatrixRequest] = []
+        rows: List[Optional[int]] = []
+        for prefix, result in zip(prefixes, results):
+            if result.ok and result.text:
+                rows.append(len(matrix_requests))
+                matrix_requests.append(
+                    ScoreMatrixRequest(
+                        agents=tuple(
+                            AgentContext(
+                                context=a_user + prefix,
+                                system_prompt=a_system,
+                                chat=False,
+                            )
+                            for a_system, a_user in spec.agent_prompts
+                        ),
+                        candidates=(result.text,),
+                        stat="sum",
+                        default=spec.failure_logprob,
+                    )
+                )
+            else:
+                rows.append(None)
+        matrices = None
+        if matrix_requests and n_agents:
+            matrices = score_matrix_many(self.backend, matrix_requests)
+            self.dispatch_count += 1
+        out: List[Tuple[List[int], str, List[float], bool]] = []
+        for result, row in zip(results, rows):
+            if not result.ok:
+                out.append(([], "", [], False))
+            elif not result.text:
+                out.append(([], "", [0.0] * n_agents, True))
+            else:
+                totals = (
+                    [float(v) for v in matrices[row].utilities[0]]
+                    if matrices is not None
+                    else []
+                )
                 out.append((list(result.token_ids), result.text, totals, True))
         return out
 
@@ -260,6 +324,8 @@ class PrefixTokenSearchSession:
         ]
         proposals = self.backend.next_token_logprobs(requests)
         self.dispatch_count += 1
+        if getattr(spec, "matrix_scoring", True):
+            return self._score_proposals_matrix(prefixes, proposals)
 
         score_requests = []
         for prefix, candidates in zip(prefixes, proposals):
@@ -277,6 +343,63 @@ class PrefixTokenSearchSession:
         if score_requests:
             self.dispatch_count += 1
         return self._zip_scores(proposals, scores)
+
+    def _score_proposals_matrix(
+        self, prefixes: Sequence[str], proposals
+    ) -> List[List[ScoredCandidate]]:
+        """Proposal scoring via the utility-matrix seam: one
+        (candidates x agents) matrix per prefix — same cells, same order,
+        ONE backend call for all prefixes (matching the flat batch's
+        dispatch count).  Stat "last" is the per-call path's
+        ``logprobs[-1]`` exactly, so fallback values are byte-identical."""
+        from consensus_tpu.backends.score_matrix import (
+            AgentContext,
+            ScoreMatrixRequest,
+            score_matrix_many,
+        )
+
+        spec = self.spec
+        n_agents = len(spec.agent_prompts)
+        matrix_requests = [
+            ScoreMatrixRequest(
+                agents=tuple(
+                    AgentContext(
+                        context=a_user + prefix,
+                        system_prompt=a_system,
+                        chat=False,
+                    )
+                    for a_system, a_user in spec.agent_prompts
+                ),
+                candidates=tuple(c.token for c in candidates),
+                stat="last",
+                default=spec.failure_logprob,
+            )
+            for prefix, candidates in zip(prefixes, proposals)
+        ]
+        total_cells = sum(len(c) for c in proposals) * n_agents
+        matrices = None
+        if total_cells:
+            matrices = score_matrix_many(self.backend, matrix_requests)
+            self.dispatch_count += 1
+        out: List[List[ScoredCandidate]] = []
+        for i, candidates in enumerate(proposals):
+            slot_out = []
+            for ci, candidate in enumerate(candidates):
+                agent_lps = (
+                    tuple(float(v) for v in matrices[i].utilities[ci])
+                    if matrices is not None
+                    else ()
+                )
+                slot_out.append(
+                    ScoredCandidate(
+                        token=candidate.token,
+                        token_id=candidate.token_id,
+                        ref_logprob=candidate.logprob,
+                        agent_logprobs=agent_lps,
+                    )
+                )
+            out.append(slot_out)
+        return out
 
     def _propose_and_score(self) -> List[List[ScoredCandidate]]:
         # Seed family 0: trunk/beam steps (family 1 = suffix trees) — the

@@ -376,7 +376,10 @@ class TPUBackend:
         # cache events per padded program shape, H2D/D2H transfer timings —
         # recorded into the process registry (metrics.json / bench extra).
         self.instruments = BackendInstruments("tpu")
-        self.call_counts = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+        self.call_counts = {
+            "generate": 0, "score": 0, "next_token": 0, "embed": 0,
+            "score_matrix": 0,
+        }
         # Token-honest accounting (VERDICT r2 #4): "generated" counts
         # statement tokens actually emitted (what the API baseline bills as
         # output); "scored" counts teacher-forced positions whose logprob a
@@ -384,6 +387,10 @@ class TPUBackend:
         # session candidate x agent evaluations).  Cell-level deltas land in
         # each run dir's token_counts.json (experiment.py).
         self.token_counts = {"generated": 0, "scored": 0}
+        # Fused utility-matrix accounting (score_matrix): device chunk
+        # launches and per-call fallbacks — the chunked-under-budget tests
+        # and BENCH_SCORE read these.
+        self.matrix_stats = {"calls": 0, "chunks": 0, "fallbacks": 0}
         self._unseeded_calls = 0
         # Guards the unseeded-call nonce: concurrent sweep cells opening
         # sessions/batches must never derive the same "fresh" stream.
@@ -1302,6 +1309,302 @@ class TPUBackend:
                 )
             )
         return results
+
+    # -- fused (candidates x agents) utility matrix ---------------------------
+
+    #: KV page width of the fused scoring pool.  Small pages keep the
+    #: shared/private split fine-grained: everything up to the last full
+    #: page of an agent context is shared read-only across all candidate
+    #: rows; only the <=15-token tail plus the candidate re-runs per row.
+    _SCORE_PAGE_SIZE = 16
+
+    def score_matrix(self, requests) -> List:
+        """Evaluate whole (candidates x agents) utility matrices on device.
+
+        Each matrix runs as ONE logical program: per-agent context pages
+        are prefilled once (deduped across agents sharing a rendered
+        prefix) and shared READ-ONLY by every candidate row via block
+        tables; the flattened candidate-major row batch is chunked under
+        the live-session HBM budget and sharded over the dp mesh; per-row
+        logprob reductions and the welfare fold happen on device
+        (models/stepper.py: paged_score_chunk / utility_matrix).  Only the
+        (C, A) utilities, the (C,) welfare vector, and the moments aux
+        cross D2H — never a per-token logprob vector.  Requests whose
+        rows would need the per-call scorer's truncation semantics fall
+        back to it wholesale, keeping truncation behavior in one place.
+        """
+        from consensus_tpu.backends.score_matrix import (
+            fallback_score_matrix_many,
+            record_matrix,
+            reduce_matrix,
+        )
+
+        out = []
+        for request in requests:
+            self.call_counts["score_matrix"] += 1
+            self.matrix_stats["calls"] += 1
+            if not request.candidates or not request.agents:
+                out.append(reduce_matrix(request, [], path="fused"))
+                continue
+            result = self._score_matrix_fused(request)
+            if result is None:  # needs per-call truncation semantics
+                self.matrix_stats["fallbacks"] += 1
+                result = fallback_score_matrix_many(self, [request])[0]
+            else:
+                record_matrix(result, len(request.agents))
+            out.append(result)
+        return out
+
+    def _score_matrix_fused(self, request):
+        from consensus_tpu.backends.score_matrix import ScoreMatrixResult
+        from consensus_tpu.models.stepper import (
+            make_page_state,
+            paged_prefill_chunk,
+            paged_score_chunk,
+            utility_matrix,
+        )
+
+        ps = self._SCORE_PAGE_SIZE
+        mesh = self.mesh_plan.mesh if self.mesh_plan is not None else None
+        n_candidates = len(request.candidates)
+        n_agents = len(request.agents)
+
+        # Tokenize once per unique rendered agent prefix (agents routinely
+        # share the issue framing) and once per candidate.
+        prefix_ids: Dict[str, List[int]] = {}
+        agent_prefixes: List[str] = []
+        for agent in request.agents:
+            prefix = self._score_prefix(agent.to_score_request(""))
+            if prefix not in prefix_ids:
+                prefix_ids[prefix] = self.tokenizer.encode(prefix, add_bos=True)
+            agent_prefixes.append(prefix)
+        cont_ids = [self.tokenizer.encode(c) for c in request.candidates]
+        max_cont = max(len(c) for c in cont_ids)
+        if any(
+            len(ids) + max_cont > self.max_context
+            for ids in prefix_ids.values()
+        ):
+            return None  # per-call scorer owns truncation semantics
+
+        # Shared page layout: each unique context owns the pages below its
+        # last full page boundary; the remaining 1..ps-token tail is
+        # re-fed per row so the hidden state at the final context position
+        # exists to teacher-force the first candidate token.
+        shared: Dict[str, Tuple[int, int, int]] = {}  # prefix -> (first, npg, n0)
+        next_page = 0
+        for prefix, ids in prefix_ids.items():
+            n0 = ((len(ids) - 1) // ps) * ps
+            shared[prefix] = (next_page, n0 // ps, n0)
+            next_page += n0 // ps
+        shared_total = next_page
+
+        # Flattened candidate-major rows; q block = context tail + all but
+        # the last candidate token (targets are the NEXT stream token).
+        rows = []  # (prefix, cont, q_len, n_private)
+        max_q = 1
+        max_private = 1
+        max_blocks = 1
+        for cont in cont_ids:
+            for prefix in agent_prefixes:
+                ids = prefix_ids[prefix]
+                _, npg, n0 = shared[prefix]
+                q_len = (len(ids) - n0) + max(len(cont) - 1, 0)
+                n_private = (n0 + q_len - 1) // ps - n0 // ps + 1
+                rows.append((prefix, cont, q_len, n_private))
+                max_q = max(max_q, q_len)
+                max_private = max(max_private, n_private)
+                max_blocks = max(max_blocks, npg + n_private)
+
+        # Chunk the row batch under the live-session HBM budget: pow2 row
+        # buckets so the compiled-variant space stays small, halved until
+        # the page pool (shared + per-row private + sink) fits.
+        dtype = jnp.dtype(self.params["embed"].dtype)
+        page_bytes = (
+            self.config.n_layers * ps * self.config.n_kv_heads
+            * self.config.head_dim * dtype.itemsize * 2
+        )
+
+        def pool_bytes(n_rows: int) -> int:
+            return (shared_total + n_rows * max_private + 1) * page_bytes
+
+        total_rows = len(rows)
+        chunk_rows = min(
+            _bucket(total_rows, minimum=8),
+            _bucket(max(self.max_batch_rows, 64), minimum=8),
+        )
+        budget = self._session_budget.cap
+        while chunk_rows > 1 and pool_bytes(chunk_rows) > budget:
+            chunk_rows //= 2
+        if pool_bytes(chunk_rows) > budget:
+            return None  # even one row over-commits; per-call path chunks finer
+        chunk_rows = max(chunk_rows, self._dp)
+        width = _bucket(max_q, minimum=ps)
+        num_pages = shared_total + chunk_rows * max_private
+        sink = num_pages
+
+        nbytes = pool_bytes(chunk_rows)
+        self._session_budget.acquire(nbytes)
+        try:
+            state = make_page_state(
+                self.config, num_pages, ps, dtype=dtype, mesh=mesh
+            )
+            state = self._prefill_shared_pages(state, prefix_ids, shared, sink, mesh)
+            chunk_stats = []
+            for start in range(0, total_rows, chunk_rows):
+                chunk = rows[start : start + chunk_rows]
+                stats, state = self._score_matrix_chunk(
+                    state, chunk, shared, prefix_ids, chunk_rows, width,
+                    max_blocks, shared_total, max_private, sink, mesh,
+                )
+                chunk_stats.append(tuple(s[: len(chunk)] for s in stats))
+                self.matrix_stats["chunks"] += 1
+            stats = tuple(
+                jnp.concatenate([cs[i] for cs in chunk_stats])
+                for i in range(4)
+            )
+            utilities, welfare_vals, aux = utility_matrix(
+                stats, n_candidates, n_agents,
+                stat=request.stat, rule=request.welfare_rule,
+                default=request.default,
+            )
+            fetched = self._fetch(
+                *([utilities, welfare_vals] + ([aux] if aux is not None else []))
+            )
+        finally:
+            self._session_budget.release(nbytes)
+        utilities_np, welfare_np = fetched[0], fetched[1]
+        aux_np = fetched[2] if aux is not None else None
+        self.token_counts["scored"] += n_agents * sum(len(c) for c in cont_ids)
+        d2h = utilities_np.nbytes + welfare_np.nbytes + (
+            aux_np.nbytes if aux_np is not None else 0
+        )
+        return ScoreMatrixResult(
+            utilities=utilities_np,
+            welfare=welfare_np,
+            best=int(np.argmax(welfare_np)) if welfare_np.size else 0,
+            aux=aux_np,
+            cells=n_candidates * n_agents,
+            d2h_bytes=d2h,
+            path="fused",
+        )
+
+    def _prefill_shared_pages(self, state, prefix_ids, shared, sink, mesh):
+        """Ingest every unique agent context's full pages (one row per
+        unique prefix, chunked along the sequence).  Rows padding the pow2
+        batch bucket duplicate row 0 with writes routed to the sink."""
+        from consensus_tpu.models.stepper import paged_prefill_chunk
+
+        ps = self._SCORE_PAGE_SIZE
+        pre = [p for p in prefix_ids if shared[p][1] > 0]
+        if not pre:
+            return state
+        n_rows = _bucket(len(pre), minimum=8)
+        max_n0 = max(shared[p][2] for p in pre)
+        chunk = min(256, _bucket(max_n0, minimum=ps))
+        n_blocks = max(shared[p][1] for p in pre)
+        tables = np.full((n_rows, n_blocks), -1, np.int32)
+        for r, p in enumerate(pre):
+            first, npg, _ = shared[p]
+            tables[r, :npg] = np.arange(first, first + npg, dtype=np.int32)
+        tables[len(pre):] = tables[0]
+        pad_id = self.tokenizer.pad_id
+        for k in range(0, max_n0, chunk):
+            tokens = np.full((n_rows, chunk), pad_id, np.int32)
+            valid = np.zeros((n_rows, chunk), bool)
+            lengths = np.zeros((n_rows,), np.int32)
+            write_pages = np.full((n_rows, chunk), sink, np.int32)
+            write_offsets = np.zeros((n_rows, chunk), np.int32)
+            for r, p in enumerate(pre):
+                ids = prefix_ids[p]
+                first, _, n0 = shared[p]
+                hi = min(n0, k + chunk)
+                lengths[r] = hi  # == n0 once the row is complete
+                if hi <= k:
+                    continue
+                span = ids[k:hi]
+                valid[r, : len(span)] = True
+                tokens[r, : len(span)] = span
+                for j in range(len(span)):
+                    write_pages[r, j] = first + (k + j) // ps
+                    write_offsets[r, j] = (k + j) % ps
+            # Pad rows ride row 0's shape (valid positions, table) but
+            # write only to the sink — never a real page.
+            tokens[len(pre):] = tokens[0]
+            valid[len(pre):] = valid[0]
+            lengths[len(pre):] = lengths[0]
+            self.instruments.record_launch("score_matrix_prefill", (n_rows, chunk))
+            # lengths is rank-1: jit's in-program constraint shards it.
+            placed = self._place_batch(
+                tokens, valid, tables, write_pages, write_offsets
+            )
+            _, state = paged_prefill_chunk(
+                self.params, self.config, placed[0], placed[1], state,
+                placed[2], jnp.asarray(lengths), placed[3], placed[4],
+                mesh=mesh,
+            )
+        return state
+
+    def _score_matrix_chunk(
+        self, state, chunk, shared, prefix_ids, n_rows, width,
+        max_blocks, shared_total, max_private, sink, mesh,
+    ):
+        """One fused teacher-forced pass over a chunk of matrix rows."""
+        from consensus_tpu.models.stepper import paged_score_chunk
+
+        ps = self._SCORE_PAGE_SIZE
+        pad_id = self.tokenizer.pad_id
+        tokens = np.full((n_rows, width), pad_id, np.int32)
+        targets = np.zeros((n_rows, width), np.int32)
+        score_mask = np.zeros((n_rows, width), bool)
+        chunk_valid = np.zeros((n_rows, width), bool)
+        tables = np.full((n_rows, max_blocks), -1, np.int32)
+        lengths = np.zeros((n_rows,), np.int32)
+        write_pages = np.full((n_rows, width), sink, np.int32)
+        write_offsets = np.zeros((n_rows, width), np.int32)
+        for r, (prefix, cont, q_len, n_private) in enumerate(chunk):
+            ids = prefix_ids[prefix]
+            first, npg, n0 = shared[prefix]
+            stream = ids + cont
+            block = stream[n0 : n0 + q_len]
+            tokens[r, : q_len] = block
+            chunk_valid[r, : q_len] = True
+            lengths[r] = n0 + q_len
+            tables[r, :npg] = np.arange(first, first + npg, dtype=np.int32)
+            base = shared_total + r * max_private
+            tables[r, npg : npg + n_private] = np.arange(
+                base, base + n_private, dtype=np.int32
+            )
+            for j in range(q_len):
+                pos = n0 + j
+                write_pages[r, j] = base + pos // ps - n0 // ps
+                write_offsets[r, j] = pos % ps
+                if pos + 1 < len(stream):
+                    targets[r, j] = stream[pos + 1]
+            lo = len(ids) - 1 - n0
+            score_mask[r, lo : lo + len(cont)] = bool(cont)
+        # Pad rows duplicate row 0 (well-defined positions/attention) but
+        # write to the sink and score nothing.
+        n_real = len(chunk)
+        tokens[n_real:] = tokens[0]
+        targets[n_real:] = targets[0]
+        chunk_valid[n_real:] = chunk_valid[0]
+        lengths[n_real:] = lengths[0]
+        tables[n_real:] = tables[0]
+        self.instruments.record_padding(
+            "score_matrix", n_rows, width,
+            sum(q for (_, _, q, _) in chunk),
+        )
+        self.instruments.record_launch("score_matrix", (n_rows, width))
+        # lengths is rank-1: jit's in-program constraint shards it.
+        placed = self._place_batch(
+            tokens, targets, score_mask, chunk_valid, tables,
+            write_pages, write_offsets,
+        )
+        return paged_score_chunk(
+            self.params, self.config, placed[0], placed[1], placed[2],
+            placed[3], state, placed[4], jnp.asarray(lengths), placed[5],
+            placed[6], mesh=mesh,
+        )
 
     # -- next-token distribution ----------------------------------------------
 

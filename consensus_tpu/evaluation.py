@@ -73,11 +73,16 @@ class StatementEvaluator:
         judge_backend: Optional[Backend] = None,
         llm_judge_model: str = "",
         embedder: Optional[Any] = None,
+        matrix_scoring: bool = True,
     ):
         self.backend = backend
         self.evaluation_model = evaluation_model
         self.judge_backend = judge_backend
         self.llm_judge_model = llm_judge_model
+        #: Route the (statement x agent) utility pass through the
+        #: score_matrix seam (fused on-device where available; byte-exact
+        #: per-call fallback elsewhere).  False keeps the flat score batch.
+        self.matrix_scoring = bool(matrix_scoring)
         # Cosine-family embeddings: a dedicated encoder when configured
         # (reference uses BAAI/bge-large-en-v1.5, src/utils.py:376-407),
         # else the generation LM's pooled hiddens (consensus_tpu.embedding).
@@ -132,19 +137,7 @@ class StatementEvaluator:
         stmt_vecs, opinion_vecs = vectors[:n], vectors[n:]
 
         # -- logprob utilities (one score batch over statements x agents) -
-        requests = [
-            ScoreRequest(
-                context=EVAL_SYSTEM_TEMPLATE.format(issue=issue, opinion=opinion),
-                continuation=statement,
-                chat=True,
-                # Reference parity: eval template in the system slot, the
-                # statement scored as user-turn content (evaluation.py:182).
-                role="user",
-            )
-            for statement in statements
-            for _, opinion in agents
-        ]
-        score_results = self.backend.score(requests)
+        moments = self._score_moments(statements, issue, agents)
 
         judge_scores_all: List[Optional[List[Optional[float]]]] = [None] * n
         if include_llm_judge and self.judge_backend is not None:
@@ -157,23 +150,91 @@ class StatementEvaluator:
                 agents,
                 stmt_vecs[i],
                 opinion_vecs,
-                score_results[i * a : (i + 1) * a],
+                moments[i * a : (i + 1) * a],
                 judge_scores_all[i],
             )
             for i in range(n)
         ]
+
+    def _score_moments(
+        self,
+        statements: List[str],
+        issue: str,
+        agents: List[Tuple[str, str]],
+    ) -> List[Tuple[float, float]]:
+        """Flat (statement-major, agent-minor) list of per-cell
+        ``(mean logprob, mean prob)`` in float64 — the evaluator's
+        perplexity accounting.  Matrix path: ONE utility-matrix call with
+        ``stat="moments"`` (utilities carry the mean logprob, ``aux`` the
+        mean prob); the fallback backend reduces the identical per-call
+        rows with identical float64 expressions, so metrics are
+        byte-stable across the seam."""
+        if self.matrix_scoring:
+            from consensus_tpu.backends.score_matrix import (
+                AgentContext,
+                ScoreMatrixRequest,
+                score_matrix_many,
+            )
+
+            result = score_matrix_many(
+                self.backend,
+                [
+                    ScoreMatrixRequest(
+                        agents=tuple(
+                            AgentContext(
+                                context=EVAL_SYSTEM_TEMPLATE.format(
+                                    issue=issue, opinion=opinion
+                                ),
+                                chat=True,
+                                # Reference parity: eval template in the
+                                # system slot, the statement scored as
+                                # user-turn content (evaluation.py:182).
+                                role="user",
+                            )
+                            for _, opinion in agents
+                        ),
+                        candidates=tuple(statements),
+                        stat="moments",
+                    )
+                ],
+            )[0]
+            utilities = np.asarray(result.utilities, dtype=np.float64)
+            aux = np.asarray(result.aux, dtype=np.float64)
+            return [
+                (float(lp), float(p))
+                for lp, p in zip(utilities.ravel(), aux.ravel())
+            ]
+        requests = [
+            ScoreRequest(
+                context=EVAL_SYSTEM_TEMPLATE.format(issue=issue, opinion=opinion),
+                continuation=statement,
+                chat=True,
+                # Reference parity: eval template in the system slot, the
+                # statement scored as user-turn content (evaluation.py:182).
+                role="user",
+            )
+            for statement in statements
+            for _, opinion in agents
+        ]
+        out = []
+        for result in self.backend.score(requests):
+            lps = np.asarray(result.logprobs, dtype=np.float64)
+            avg_lp = float(lps.mean()) if lps.size else -10.0
+            avg_p = float(np.exp(lps).mean()) if lps.size else 0.0
+            out.append((avg_lp, avg_p))
+        return out
 
     def _assemble_metrics(
         self,
         agents: List[Tuple[str, str]],
         statement_vec,
         opinion_vecs,
-        results: List[Any],
+        moments: List[Tuple[float, float]],
         judge_scores: Optional[List[Optional[float]]],
     ) -> Dict[str, Any]:
-        """Metric-column assembly from precomputed backend results (shared
-        by the single and batched paths — column names/semantics pinned by
-        the golden run dir)."""
+        """Metric-column assembly from precomputed ``(avg_logprob,
+        avg_prob)`` moments (shared by the single and batched paths —
+        column names/semantics pinned by the golden run dir)."""
         metrics: Dict[str, Any] = {}
         cosines = opinion_vecs @ statement_vec  # embeddings are unit-norm
         for (name, _), cos in zip(agents, cosines):
@@ -181,10 +242,7 @@ class StatementEvaluator:
             metrics[f"utility_cosine_similarity_{name}"] = float(cos)
 
         avg_logprobs, avg_probs, perplexities = [], [], []
-        for (name, _), result in zip(agents, results):
-            lps = np.asarray(result.logprobs, dtype=np.float64)
-            avg_lp = float(lps.mean()) if lps.size else -10.0
-            avg_p = float(np.exp(lps).mean()) if lps.size else 0.0
+        for (name, _), (avg_lp, avg_p) in zip(agents, moments):
             ppl = float(np.exp(-avg_lp))
             avg_logprobs.append(avg_lp)
             avg_probs.append(avg_p)

@@ -126,6 +126,7 @@ class BeamSearchGenerator(BaseGenerator):
                 bias_value=bias_value,
                 max_steps=max_tokens,
                 failure_logprob=DEFAULT_FAILURE_REWARD,
+                matrix_scoring=bool(cfg.get("matrix_scoring", True)),
             ),
         )
 

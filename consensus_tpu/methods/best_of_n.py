@@ -117,9 +117,41 @@ class BestOfNGenerator(BaseGenerator):
     def score_candidates(
         self, issue: str, agent_opinions: Dict[str, str], candidates: List[str]
     ) -> np.ndarray:
-        """(num_candidates, num_agents) mean-logprob utility matrix — ONE
-        batched score call over the flattened (candidate × agent) grid."""
+        """(num_candidates, num_agents) mean-logprob utility matrix.
+
+        Default path (``matrix_scoring``, on unless configured off): ONE
+        utility-matrix call through the score_matrix seam — a fused
+        on-device program on backends that have one, or the byte-identical
+        batched per-call fallback otherwise.  ``matrix_scoring: false``
+        keeps the original flattened per-call score batch."""
         agents = list(agent_opinions.items())
+        if bool(self.config.get("matrix_scoring", True)):
+            from consensus_tpu.backends.score_matrix import (
+                AgentContext,
+                ScoreMatrixRequest,
+                score_matrix_many,
+            )
+
+            contexts = []
+            for _, opinion in agents:
+                system, user = agent_prompt(issue, opinion)
+                contexts.append(
+                    AgentContext(context=user, system_prompt=system, chat=True)
+                )
+            result = score_matrix_many(
+                self.backend,
+                [
+                    ScoreMatrixRequest(
+                        agents=tuple(contexts),
+                        candidates=tuple(candidates),
+                        stat="mean",
+                        default=DEFAULT_REWARD,
+                    )
+                ],
+            )[0]
+            return np.asarray(result.utilities, dtype=np.float32).reshape(
+                len(candidates), len(agents)
+            )
         requests = []
         for candidate in candidates:
             for _, opinion in agents:

@@ -116,6 +116,7 @@ class FiniteLookaheadGenerator(BaseGenerator):
                 spec_draft_len=int(
                     cfg.get("spec_draft_len", rollout_depth or 8)
                 ),
+                matrix_scoring=bool(cfg.get("matrix_scoring", True)),
             ),
         )
 

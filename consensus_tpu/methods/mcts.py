@@ -175,6 +175,7 @@ class MCTSGenerator(BaseGenerator):
                 spec_draft_len=int(
                     cfg.get("spec_draft_len", self._rollout_depth)
                 ),
+                matrix_scoring=bool(cfg.get("matrix_scoring", True)),
             ),
         )
         self._salt = 0

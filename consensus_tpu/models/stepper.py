@@ -58,6 +58,11 @@ from consensus_tpu.models.transformer import (
     _softcap,
 )
 from consensus_tpu.ops.decode_attention import paged_attention
+from consensus_tpu.ops.welfare import (
+    DEFAULT_REWARD,
+    WELFARE_RULES,
+    sanitize_utilities,
+)
 
 
 class SearchState(NamedTuple):
@@ -860,6 +865,134 @@ def paged_decode_step(
     state = _constrain_state(state, mesh)
     logits = project_logits(params, config, hidden[:, 0, :])
     return _constrain(logits, mesh, "data", "model"), state
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "mesh"), donate_argnums=(6,)
+)
+def paged_score_chunk(
+    params,
+    config: ModelConfig,
+    tokens: jax.Array,  # (B, S) int32 — query block per matrix row
+    targets: jax.Array,  # (B, S) int32 — stream token AFTER each query pos
+    score_mask: jax.Array,  # (B, S) bool — continuation positions only
+    chunk_valid: jax.Array,  # (B, S) bool — real columns of this chunk
+    state: PagedSlotState,
+    block_tables: jax.Array,  # (B, max_blocks) — shared ctx + private pages
+    lengths: jax.Array,  # (B,) int32 — stream length AFTER this call
+    write_pages: jax.Array,  # (B, S) int32 — private pages / sink
+    write_offsets: jax.Array,  # (B, S) int32
+    mesh: Optional[Mesh] = None,  # static: rows over data, heads over model
+) -> Tuple[Tuple[jax.Array, jax.Array, jax.Array, jax.Array], PagedSlotState]:
+    """Teacher-forced scoring of one (candidates x agents) row chunk over
+    shared context pages, reduced ON DEVICE.
+
+    Each row's query block is the tail of its agent context (the tokens
+    past the last full shared page — at least one, so the hidden at the
+    final context position exists to teacher-force the first candidate
+    token) followed by all but the last candidate token.  The block table
+    names the agent's READ-ONLY shared context pages first and the row's
+    private tail pages after; writes land only in the private region (or
+    the sink for padding columns), so many rows attend the same agent
+    prefill bytes without copying them — the PagedAttention sharing trick
+    applied to scoring.
+
+    The logprob of stream token p+1 is gathered at query position p via a
+    ``lax.scan`` over the S axis — per-position (B, V) logits instead of a
+    (B, S, V) f32 transient, which matters at a 256k vocab.  Returns the
+    per-row reductions ``(sum_lp, last_lp, sum_exp_lp, count)`` — enough
+    for every consumer statistic (mean / sum / last / moments) — and the
+    updated page state.  No per-token vector survives to be fetched.
+    """
+    tokens = _constrain(tokens, mesh, "data", None)
+    targets = _constrain(targets, mesh, "data", None)
+    score_mask = _constrain(score_mask, mesh, "data", None)
+    chunk_valid = _constrain(chunk_valid, mesh, "data", None)
+    block_tables = _constrain(block_tables, mesh, "data", None)
+    lengths = _constrain(lengths, mesh, "data")
+    write_pages = _constrain(write_pages, mesh, "data", None)
+    write_offsets = _constrain(write_offsets, mesh, "data", None)
+    state = _constrain_state(state, mesh)
+    b, s = tokens.shape
+    n_valid = jnp.sum(chunk_valid.astype(jnp.int32), axis=1)  # (B,)
+    start = lengths - n_valid
+    positions = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    hidden, state = _paged_forward(
+        params, config, tokens, positions, state,
+        block_tables, lengths, write_pages, write_offsets,
+    )
+    state = _constrain_state(state, mesh)
+    mask = score_mask & chunk_valid
+
+    def score_col(carry, xs):
+        h_col, t_col, m_col = xs  # (B, D), (B,), (B,)
+        logits = project_logits(params, config, h_col)  # (B, V) f32
+        logits = _constrain(logits, mesh, "data", "model")
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        t_lp = jnp.take_along_axis(lp, t_col[:, None], axis=1)[:, 0]
+        sum_lp, last_lp, sum_exp, counts = carry
+        return (
+            sum_lp + jnp.where(m_col, t_lp, 0.0),
+            jnp.where(m_col, t_lp, last_lp),
+            sum_exp + jnp.where(m_col, jnp.exp(t_lp), 0.0),
+            counts + m_col.astype(jnp.int32),
+        ), None
+
+    init = (
+        jnp.zeros((b,), jnp.float32),
+        jnp.zeros((b,), jnp.float32),
+        jnp.zeros((b,), jnp.float32),
+        jnp.zeros((b,), jnp.int32),
+    )
+    (sum_lp, last_lp, sum_exp, counts), _ = jax.lax.scan(
+        score_col,
+        init,
+        (
+            jnp.moveaxis(hidden, 0, 1),  # (S, B, D)
+            jnp.moveaxis(targets, 0, 1),
+            jnp.moveaxis(mask, 0, 1),
+        ),
+    )
+    return (sum_lp, last_lp, sum_exp, counts), state
+
+
+def utility_matrix(
+    stats: Tuple[jax.Array, jax.Array, jax.Array, jax.Array],
+    n_candidates: int,
+    n_agents: int,
+    stat: str = "mean",
+    rule: str = "egalitarian",
+    default: float = DEFAULT_REWARD,
+) -> Tuple[jax.Array, jax.Array, Optional[jax.Array]]:
+    """Fold flattened (C*A,) per-row reductions into the (C, A) utility
+    matrix and its welfare vector, entirely on device: sanitize -> welfare
+    rule over the agent axis.  Rows with zero scored tokens (empty
+    continuation) take ``default`` — the per-call ``ScoreResult`` empty
+    semantics.  Returns ``(utilities (C, A) f32, welfare (C,), aux)``
+    where ``aux`` is the per-cell mean probability for ``stat="moments"``
+    (the evaluator's perplexity accounting) and ``None`` otherwise.  The
+    caller fetches only these — the welfare argmax stays a host
+    ``np.argmax`` so tie-breaking is pinned to numpy first-max."""
+    sum_lp, last_lp, sum_exp, counts = stats
+    counts_f = jnp.maximum(counts, 1).astype(jnp.float32)
+    if stat in ("mean", "moments"):
+        value = sum_lp / counts_f
+    elif stat == "sum":
+        value = sum_lp
+    elif stat == "last":
+        value = last_lp
+    else:
+        raise ValueError(f"unknown stat {stat!r}")
+    scored = counts > 0
+    value = jnp.where(scored, value, jnp.asarray(default, jnp.float32))
+    utilities = value.reshape(n_candidates, n_agents)
+    welfare_vals = WELFARE_RULES[rule](sanitize_utilities(utilities), axis=1)
+    aux = None
+    if stat == "moments":
+        aux = jnp.where(scored, sum_exp / counts_f, 0.0).reshape(
+            n_candidates, n_agents
+        )
+    return utilities, welfare_vals, aux
 
 
 @functools.partial(

@@ -66,6 +66,30 @@ def _scenario_sequence(
     )
 
 
+def _expand_agents(opinions: Dict[str, str], agents: int) -> Dict[str, str]:
+    """Deterministic many-agent variant of a scenario's opinion dict for
+    the AAMAS 50-200 agent regime: cycle the base opinions in sorted-name
+    order, restating each copy as a distinct panel member (variant-tagged
+    name AND variant-tagged text, so prompt dedup/prefix sharing can't
+    collapse the extra agents).  ``agents <= len(opinions)`` truncates to
+    the first ``agents`` base agents unchanged."""
+    base = sorted(opinions.items())
+    if agents <= len(base):
+        return dict(base[:agents])
+    out: Dict[str, str] = {}
+    for i in range(agents):
+        name, opinion = base[i % len(base)]
+        variant = i // len(base)
+        if variant == 0:
+            out[name] = opinion
+        else:
+            out[f"{name}_v{variant}"] = (
+                f"{opinion} (Restated by panel member {i}, holding the "
+                f"same position — emphasis variant {variant}.)"
+            )
+    return out
+
+
 def scenario_requests(
     count: int,
     method: str = "best_of_n",
@@ -74,17 +98,24 @@ def scenario_requests(
     evaluate: bool = False,
     timeout_s: Optional[float] = None,
     scenario_repeat: Optional[str] = None,
+    agents: Optional[int] = None,
 ) -> List[Dict[str, Any]]:
     """``count`` request payloads cycling the AAMAS scenarios (see
-    :func:`_scenario_sequence` for the ``scenario_repeat`` mixes)."""
+    :func:`_scenario_sequence` for the ``scenario_repeat`` mixes).
+    ``agents`` expands every scenario to exactly that many deterministic
+    opinion-holders (:func:`_expand_agents`) — the many-agent regime the
+    utility-matrix scoring path is sized for."""
     keys = sorted(SCENARIOS)
     order = _scenario_sequence(count, len(keys), scenario_repeat, base_seed)
     payloads = []
     for i in range(count):
         scenario = SCENARIOS[keys[order[i]]]
+        opinions = dict(scenario["agent_opinions"])
+        if agents is not None:
+            opinions = _expand_agents(opinions, int(agents))
         payload: Dict[str, Any] = {
             "issue": scenario["issue"],
-            "agent_opinions": dict(scenario["agent_opinions"]),
+            "agent_opinions": opinions,
             "method": method,
             "params": dict(params or {}),
             "seed": base_seed + i,

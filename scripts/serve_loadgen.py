@@ -49,6 +49,12 @@ def main(argv=None) -> int:
                              "over all scenarios); repeated scenarios are "
                              "what the prefix KV cache accelerates, and "
                              "the report then shows prefix_hit_fraction")
+    parser.add_argument("--agents", type=int, default=None, metavar="N",
+                        help="expand every scenario to exactly N "
+                             "deterministic opinion-holders (base opinions "
+                             "cycled as variant-tagged panel members) — the "
+                             "AAMAS 50-200 agent regime the utility-matrix "
+                             "scoring path is sized for")
     parser.add_argument("--evaluate", action="store_true",
                         help="request per-agent utilities + welfare too")
     parser.add_argument("--timeout-s", type=float, default=None,
@@ -126,6 +132,7 @@ def main(argv=None) -> int:
         evaluate=args.evaluate,
         timeout_s=args.timeout_s,
         scenario_repeat=args.scenario_repeat,
+        agents=args.agents,
     )
 
     if args.self_contained:

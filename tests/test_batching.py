@@ -22,7 +22,8 @@ class CountingBackend:
 
     def __init__(self):
         self.inner = FakeBackend()
-        self.batches = {"generate": 0, "score": 0, "next_token": 0, "embed": 0}
+        self.batches = {"generate": 0, "score": 0, "next_token": 0,
+                        "embed": 0, "score_matrix": 0}
 
     def generate(self, requests):
         self.batches["generate"] += 1
@@ -39,6 +40,14 @@ class CountingBackend:
     def embed(self, texts):
         self.batches["embed"] += 1
         return self.inner.embed(texts)
+
+    def score_matrix(self, requests):
+        self.batches["score_matrix"] += 1
+        from consensus_tpu.backends.score_matrix import (
+            fallback_score_matrix_many,
+        )
+
+        return fallback_score_matrix_many(self.inner, requests)
 
 
 class TestBatchingBackend:

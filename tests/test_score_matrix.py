@@ -1,0 +1,446 @@
+"""Agent-parallel utility-matrix scoring (PR 10).
+
+The contract pinned here:
+
+* the fallback seam is BYTE-identical to the per-call code it replaces —
+  same ScoreRequest rows, same reduction expressions, same float64
+  values, same pinned (numpy first-max) argmax;
+* consumers gate on ``matrix_scoring`` (default ON) and produce
+  byte-identical statements/metrics with the seam on or off, across
+  seeds, on the fake backend (best-of-N, beam search, the evaluator);
+* merged score dispatches dedup identical rows (engine and legacy
+  flush) and count removals in ``engine_score_dedup_total``;
+* the fused TPU path agrees with the fallback to float tolerance with
+  the same argmax, on BOTH tiny model families and every stat;
+* a 64-agent matrix streams in chunks under a shrunken HBM session
+  budget without falling back, bit-identical to the unchunked run;
+* dp=4 and dp=1 produce identical utilities (8 virtual CPU devices
+  from conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from consensus_tpu.backends.base import PartialBatchError, ScoreRequest
+from consensus_tpu.backends.batching import BatchingBackend
+from consensus_tpu.backends.fake import FakeBackend
+from consensus_tpu.backends.score_matrix import (
+    AgentContext,
+    ScoreMatrixRequest,
+    dedup_score_requests,
+    expand_deduped,
+    expand_partial_error,
+    fallback_score_matrix_many,
+    score_matrix_many,
+    welfare_argmax,
+)
+from consensus_tpu.obs.metrics import Registry
+
+ISSUE = "Should the city build more parks or more parking?"
+OPINIONS = {
+    "alice": "Parks improve health and community.",
+    "bob": "Parking shortages strangle local business.",
+    "carol": "Both matter; phase the spending.",
+}
+
+
+def _family_total(registry, name):
+    family = (registry.snapshot().get("families") or {}).get(name) or {}
+    return sum(s.get("value", 0) for s in family.get("series", []))
+
+
+# ---------------------------------------------------------------------------
+# The seam itself
+# ---------------------------------------------------------------------------
+
+
+class TestSeam:
+    def _request(self, stat="mean"):
+        return ScoreMatrixRequest(
+            agents=(
+                AgentContext(context="ctx a", chat=False),
+                AgentContext(context="ctx b", chat=False),
+            ),
+            candidates=("one", "two", "three"),
+            stat=stat,
+        )
+
+    def test_cell_requests_candidate_major(self):
+        rows = self._request().cell_requests()
+        assert [(r.context, r.continuation) for r in rows] == [
+            ("ctx a", "one"), ("ctx b", "one"),
+            ("ctx a", "two"), ("ctx b", "two"),
+            ("ctx a", "three"), ("ctx b", "three"),
+        ]
+
+    def test_bad_stat_and_rule_rejected(self):
+        with pytest.raises(ValueError):
+            self._request(stat="median")
+        with pytest.raises(ValueError):
+            ScoreMatrixRequest(
+                agents=(AgentContext(context="c"),),
+                candidates=("x",),
+                welfare_rule="plutocratic",
+            )
+
+    def test_fallback_matches_percall_expressions(self):
+        """Every stat reduces exactly as the consumer it serves did."""
+        backend = FakeBackend()
+        request = self._request()
+        results = backend.score(request.cell_requests())
+        for stat, expect in (
+            ("mean", [r.mean(default=-10.0) for r in results]),
+            ("sum", [float(sum(r.logprobs)) for r in results]),
+            ("last", [float(r.logprobs[-1]) for r in results]),
+        ):
+            matrix = fallback_score_matrix_many(
+                backend, [self._request(stat=stat)]
+            )[0]
+            assert matrix.utilities.ravel().tolist() == expect
+        moments = fallback_score_matrix_many(
+            backend, [self._request(stat="moments")]
+        )[0]
+        for cell_lp, cell_p, r in zip(
+            moments.utilities.ravel(), moments.aux.ravel(), results
+        ):
+            lps = np.asarray(r.logprobs, dtype=np.float64)
+            assert cell_lp == float(lps.mean())
+            assert cell_p == float(np.exp(lps).mean())
+
+    def test_welfare_argmax_pins_first_max(self):
+        utilities = np.asarray([[1.0, 5.0], [2.0, 1.0], [1.0, 2.0]])
+        welfare, best = welfare_argmax(utilities, "egalitarian")
+        assert welfare.tolist() == [1.0, 1.0, 1.0]
+        assert best == 0  # first max, numpy semantics
+
+    def test_empty_matrix(self):
+        request = ScoreMatrixRequest(agents=(), candidates=())
+        result = fallback_score_matrix_many(FakeBackend(), [request])[0]
+        assert result.utilities.shape == (0, 0)
+        assert result.best == 0
+
+    def test_dedup_mapping_roundtrip(self):
+        a = ScoreRequest(context="x", continuation="1", chat=False)
+        b = ScoreRequest(context="y", continuation="2", chat=False)
+        unique, mapping = dedup_score_requests([a, b, a, a, b])
+        assert len(unique) == 2
+        assert expand_deduped(["A", "B"], mapping) == ["A", "B", "A", "A", "B"]
+
+    def test_expand_partial_error(self):
+        a = ScoreRequest(context="x", continuation="1", chat=False)
+        b = ScoreRequest(context="y", continuation="2", chat=False)
+        _, mapping = dedup_score_requests([a, b, a])
+        error = PartialBatchError("boom", ["ra", None], {1: "bad row"})
+        expanded = expand_partial_error(error, mapping)
+        assert expanded.results == ["ra", None, "ra"]
+        assert expanded.row_errors == {1: "bad row"}
+
+    def test_obs_families_recorded(self):
+        registry = Registry()
+        from consensus_tpu.backends.score_matrix import record_matrix
+
+        result = fallback_score_matrix_many(FakeBackend(), [self._request()])[0]
+        record_matrix(result, 2, registry)
+        assert _family_total(registry, "score_matrix_cells_total") == 6
+        assert _family_total(registry, "score_matrix_d2h_bytes_total") > 0
+        fam = (registry.snapshot().get("families") or {}).get(
+            "score_agents_per_call"
+        )
+        assert fam is not None
+
+
+# ---------------------------------------------------------------------------
+# Consumer byte-identity (fake backend), matrix on vs off
+# ---------------------------------------------------------------------------
+
+
+class TestConsumerIdentity:
+    @pytest.mark.parametrize("method", ["best_of_n", "beam_search"])
+    @pytest.mark.parametrize("seed", [0, 7, 123])
+    def test_statements_identical(self, method, seed):
+        from consensus_tpu.methods import get_method_generator
+
+        texts = {}
+        for matrix_on in (True, False):
+            generator = get_method_generator(
+                method,
+                FakeBackend(),
+                {"n": 4, "max_tokens": 12, "seed": seed, "beam_width": 3,
+                 "matrix_scoring": matrix_on},
+            )
+            texts[matrix_on] = generator.generate_statement(ISSUE, OPINIONS)
+        assert texts[True] == texts[False]
+
+    def test_evaluator_metrics_identical(self):
+        from consensus_tpu.evaluation import StatementEvaluator
+
+        statements = ["Fund both.", "Parks first.", "Fund both."]
+        rows = {}
+        for matrix_on in (True, False):
+            rows[matrix_on] = StatementEvaluator(
+                FakeBackend(), matrix_scoring=matrix_on
+            ).evaluate_statements_batched(statements, ISSUE, OPINIONS)
+        for on, off in zip(rows[True], rows[False]):
+            assert set(on) == set(off)
+            for key in on:
+                assert on[key] == off[key], key
+
+    def test_best_of_n_utilities_float32_cast_stable(self):
+        """best-of-N historically built an f32 matrix; the float64
+        fallback utilities must cast to the identical f32 values."""
+        from consensus_tpu.methods.best_of_n import BestOfNGenerator
+
+        backend = FakeBackend()
+        candidates = ["Fund both now.", "Parks first."]
+        on = BestOfNGenerator(
+            backend, {"matrix_scoring": True}
+        ).score_candidates(ISSUE, OPINIONS, candidates)
+        off = BestOfNGenerator(
+            backend, {"matrix_scoring": False}
+        ).score_candidates(ISSUE, OPINIONS, candidates)
+        assert on.dtype == off.dtype == np.float32
+        assert np.array_equal(on, off)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch seams: engine + legacy flush, dedup accounting
+# ---------------------------------------------------------------------------
+
+
+class TestDispatch:
+    def _request(self):
+        return ScoreMatrixRequest(
+            agents=(
+                AgentContext(context="ctx a", chat=False),
+                AgentContext(context="ctx b", chat=False),
+            ),
+            candidates=("one", "two"),
+        )
+
+    @pytest.mark.parametrize("engine", [True, False])
+    def test_batching_score_matrix_matches_direct(self, engine):
+        direct = fallback_score_matrix_many(FakeBackend(), [self._request()])[0]
+        batching = BatchingBackend(
+            FakeBackend(), registry=Registry(), engine=engine
+        )
+        try:
+            with batching.session():
+                via = score_matrix_many(batching, [self._request()])[0]
+        finally:
+            batching.close()
+        assert np.array_equal(via.utilities, direct.utilities)
+        assert via.best == direct.best
+
+    @pytest.mark.parametrize("engine", [True, False])
+    def test_score_dedup_counter(self, engine):
+        registry = Registry()
+        batching = BatchingBackend(
+            FakeBackend(), registry=registry, engine=engine
+        )
+        try:
+            duplicate = ScoreRequest(
+                context="same ctx", continuation="same cont", chat=False
+            )
+            with batching.session():
+                results = batching.score(
+                    [duplicate, duplicate,
+                     ScoreRequest(context="other", continuation="x",
+                                  chat=False)]
+                )
+            assert results[0].logprobs == results[1].logprobs
+        finally:
+            batching.close()
+        assert _family_total(registry, "engine_score_dedup_total") >= 1
+
+
+# ---------------------------------------------------------------------------
+# Fused device path (tiny real models)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_backends():
+    from consensus_tpu.backends.tpu import TPUBackend
+
+    return {
+        model: TPUBackend(model=model, dtype="float32", max_context=256)
+        for model in ("tiny-gemma2", "tiny-llama3")
+    }
+
+
+def _tiny_request(n_agents=3, n_candidates=3, stat="mean"):
+    return ScoreMatrixRequest(
+        agents=tuple(
+            AgentContext(
+                context=f"Opinion holder {i} wants more of option {i}.",
+                system_prompt="You are a panelist.",
+                chat=True,
+            )
+            for i in range(n_agents)
+        ),
+        candidates=tuple(
+            f"Candidate statement {j} about the issue." for j in range(n_candidates)
+        ),
+        stat=stat,
+    )
+
+
+class TestFusedParity:
+    @pytest.mark.parametrize("model", ["tiny-gemma2", "tiny-llama3"])
+    @pytest.mark.parametrize("stat", ["mean", "sum", "last", "moments"])
+    def test_fused_matches_fallback(self, tiny_backends, model, stat):
+        backend = tiny_backends[model]
+        request = _tiny_request(stat=stat)
+        fused = backend.score_matrix([request])[0]
+        assert fused.path == "fused"
+        fallback = fallback_score_matrix_many(backend, [request])[0]
+        np.testing.assert_allclose(
+            np.asarray(fused.utilities, np.float64),
+            fallback.utilities,
+            atol=5e-5, rtol=5e-5,
+        )
+        assert fused.best == fallback.best
+        np.testing.assert_allclose(
+            np.asarray(fused.welfare, np.float64),
+            np.asarray(fallback.welfare, np.float64),
+            atol=5e-5, rtol=5e-5,
+        )
+        if stat == "moments":
+            np.testing.assert_allclose(
+                np.asarray(fused.aux, np.float64), fallback.aux,
+                atol=5e-5, rtol=5e-5,
+            )
+
+    def test_d2h_is_reductions_only(self, tiny_backends):
+        """The fused path ships (C, A) + (C,) floats — never the per-token
+        logprob vectors the fallback reports."""
+        backend = tiny_backends["tiny-gemma2"]
+        request = _tiny_request()
+        fused = backend.score_matrix([request])[0]
+        fallback = fallback_score_matrix_many(backend, [request])[0]
+        n_cells = len(request.agents) * len(request.candidates)
+        assert fused.d2h_bytes == n_cells * 4 + len(request.candidates) * 4
+        assert fallback.d2h_bytes > 10 * fused.d2h_bytes
+
+    def test_overlong_rows_fall_back(self, tiny_backends):
+        """Rows needing the per-call scorer's truncation semantics route
+        the whole request through it."""
+        backend = tiny_backends["tiny-gemma2"]
+        request = ScoreMatrixRequest(
+            agents=(
+                AgentContext(context="word " * 400, chat=False),
+            ),
+            candidates=("short tail.",),
+        )
+        before = backend.matrix_stats["fallbacks"]
+        result = backend.score_matrix([request])[0]
+        assert result.path == "fallback"
+        assert backend.matrix_stats["fallbacks"] == before + 1
+
+    def test_64_agents_chunk_under_budget(self, tiny_backends):
+        """The acceptance case: a 64-agent matrix streams through a
+        shrunken HBM session budget in >1 chunk, no fallback, and the
+        chunked utilities are bit-identical to the unchunked run."""
+        backend = tiny_backends["tiny-gemma2"]
+        request = ScoreMatrixRequest(
+            agents=tuple(
+                AgentContext(
+                    context=f"Panel member {i} holds position variant {i}.",
+                    chat=True,
+                )
+                for i in range(64)
+            ),
+            candidates=("Fund parks first.", "Parking is essential."),
+        )
+        full = backend.score_matrix([request])[0]
+        assert full.path == "fused"
+
+        config = backend.config
+        page_bytes = (
+            config.n_layers * 16 * config.n_kv_heads * config.head_dim * 4 * 2
+        )
+        # Recompute the fused layout's shared-page total so the shrunken
+        # budget leaves room for the shared pages plus only ~8 rows of
+        # private tail pages — forcing the 128-row batch to chunk.
+        shared_pages = 0
+        for agent in request.agents:
+            ids = backend.tokenizer.encode(
+                backend._score_prefix(agent.to_score_request("")),
+                add_bos=True,
+            )
+            shared_pages += ((len(ids) - 1) // 16 * 16) // 16
+        cap = backend._session_budget.cap
+        backend._session_budget.cap = page_bytes * (shared_pages + 8 * 8 + 1)
+        chunks_before = backend.matrix_stats["chunks"]
+        fallbacks_before = backend.matrix_stats["fallbacks"]
+        try:
+            chunked = backend.score_matrix([request])[0]
+        finally:
+            backend._session_budget.cap = cap
+        assert chunked.path == "fused"
+        assert backend.matrix_stats["fallbacks"] == fallbacks_before
+        assert backend.matrix_stats["chunks"] - chunks_before > 1
+        assert np.array_equal(
+            np.asarray(chunked.utilities), np.asarray(full.utilities)
+        )
+
+    def test_dp4_matches_dp1(self, tiny_backends):
+        """Sharding the row batch over the dp mesh must not change the
+        utilities (8 virtual CPU devices from conftest)."""
+        from consensus_tpu.backends.tpu import TPUBackend
+
+        base = tiny_backends["tiny-gemma2"]
+        wide = TPUBackend(
+            model="tiny-gemma2", dtype="float32", max_context=256, dp=4,
+            params=base.params, config=base.config,
+        )
+        request = _tiny_request(n_agents=8, n_candidates=4)
+        r1 = base.score_matrix([request])[0]
+        r4 = wide.score_matrix([request])[0]
+        assert r1.path == r4.path == "fused"
+        assert np.array_equal(
+            np.asarray(r1.utilities), np.asarray(r4.utilities)
+        )
+        assert r1.best == r4.best
+
+    def test_token_accounting(self, tiny_backends):
+        backend = tiny_backends["tiny-gemma2"]
+        request = _tiny_request()
+        before = backend.token_counts["scored"]
+        backend.score_matrix([request])
+        scored = backend.token_counts["scored"] - before
+        cont_tokens = sum(
+            len(backend.tokenizer.encode(c)) for c in request.candidates
+        )
+        assert scored == len(request.agents) * cont_tokens
+
+
+# ---------------------------------------------------------------------------
+# Loadgen many-agent expansion (satellite 6)
+# ---------------------------------------------------------------------------
+
+
+class TestLoadgenAgents:
+    def test_expansion_deterministic_and_sized(self):
+        from consensus_tpu.serve.loadgen import scenario_requests
+
+        payloads = scenario_requests(3, agents=64)
+        assert all(len(p["agent_opinions"]) == 64 for p in payloads)
+        again = scenario_requests(3, agents=64)
+        assert [p["agent_opinions"] for p in payloads] == [
+            p["agent_opinions"] for p in again
+        ]
+        # Variant copies are textually distinct from their base opinion.
+        opinions = payloads[0]["agent_opinions"]
+        names = list(opinions)
+        assert any("_v" in n for n in names)
+        base = {n: o for n, o in opinions.items() if "_v" not in n}
+        for name, text in opinions.items():
+            if "_v" in name:
+                assert text not in base.values()
+
+    def test_truncation_below_base_count(self):
+        from consensus_tpu.serve.loadgen import scenario_requests
+
+        payloads = scenario_requests(1, agents=2)
+        assert len(payloads[0]["agent_opinions"]) == 2

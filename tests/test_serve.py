@@ -470,7 +470,7 @@ class TestHTTPEndToEnd:
         assert health["workers_alive"] == 2
         assert health["backend"]["alive"] is True
         assert set(health["device_batches"]) == {
-            "generate", "score", "next_token", "embed"}
+            "generate", "score", "next_token", "embed", "score_matrix"}
 
     def test_metrics_exposes_serve_families(self, server):
         _post(server.base_url, {
