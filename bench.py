@@ -808,6 +808,165 @@ def main() -> None:
                     "workload; 64 agents chunk under the same HBM budget",
         }}
 
+    # ---- BENCH_ELASTIC: full elasticity cycle on the fake fleet ----------
+    # The PR 11 acceptance surface measured: a 3-replica elastic fleet
+    # under repeated-scenario load takes a kill -> ladder loss -> same-name
+    # respawn (warm PageStore pre-seed) -> rejoin, then an autoscaler-driven
+    # scale-up to 4 and back to 3.  Reported: availability through the
+    # cycle, per-kill time-to-recover, warm-vs-cold respawn prefill tokens
+    # (the PageStore's latency floor, as a fleet-wide counter delta over
+    # the post-respawn replay), the respawned replica's first-pass prefix
+    # hit fraction, and scale-cycle monotonicity (replica count + tier
+    # changes never oscillate within a phase).  BENCH_ELASTIC=0 skips.
+    elastic_extra = {}
+    if os.environ.get("BENCH_ELASTIC", "1") != "0":
+        from consensus_tpu.obs.metrics import Registry as _Registry
+        from consensus_tpu.serve import Autoscaler, create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+
+        el_requests = int(os.environ.get("BENCH_ELASTIC_REQUESTS", "36"))
+        el_rate = float(os.environ.get("BENCH_ELASTIC_RATE", "60"))
+        el_payloads = scenario_requests(
+            el_requests, params={"n": 4, "max_tokens": NEW_TOKENS},
+            timeout_s=30.0, scenario_repeat="fixed:2",
+        )
+
+        def _counter_total(registry, name):
+            family = registry.snapshot()["families"].get(name) or {}
+            return sum(s.get("value", 0)
+                       for s in family.get("series", []))
+
+        def _wait(predicate, timeout_s):
+            deadline = time.perf_counter() + timeout_s
+            while time.perf_counter() < deadline:
+                if predicate():
+                    return True
+                time.sleep(0.02)
+            return predicate()
+
+        def _elastic_cycle(warm):
+            registry = _Registry()
+            server = create_server(
+                backend="fake", port=0, registry=registry,
+                max_inflight=2, max_queue_depth=16,
+                default_timeout_s=30.0,
+                engine_options={"prefix_cache": True},
+                fleet_size=3,
+                fleet_options={
+                    "elastic": True,
+                    "elastic_options": {"check_interval_s": 0.05,
+                                        "respawn_backoff_s": 0.05,
+                                        "harvest_interval_s": 0.1},
+                },
+            ).start()
+            router = server.scheduler
+            manager = router.manager
+            if not warm:
+                manager.page_store = None  # cold respawns: no handoff
+            try:
+                steady = run_loadgen(
+                    server.base_url, el_payloads, rate_rps=el_rate)
+                if warm:
+                    _wait(lambda: len(manager.page_store) > 0, 10.0)
+                t_kill = time.perf_counter()
+                router.kill_replica("r0")
+                recovered = _wait(
+                    lambda: manager.snapshot()["respawns"] >= 1
+                    and len(router.replicas) == 3
+                    and router.stats()["fleet"]["healthy"] == 3,
+                    15.0,
+                )
+                recover_s = time.perf_counter() - t_kill
+                prefill0 = _counter_total(
+                    registry, "engine_prefill_tokens_total")
+                replay = run_loadgen(
+                    server.base_url, el_payloads, rate_rps=el_rate)
+                prefill = _counter_total(
+                    registry, "engine_prefill_tokens_total") - prefill0
+                cache = router._replica(
+                    "r0").scheduler.batching.engine.prefix_cache
+                probes = cache.hits + cache.misses
+                return {
+                    "steady_availability": steady["availability"],
+                    "replay_availability": replay["availability"],
+                    "recovered": bool(recovered),
+                    "time_to_recover_s": round(recover_s, 3),
+                    "respawns": manager.snapshot()["respawns"],
+                    "replay_prefill_tokens": prefill,
+                    "respawn_hit_fraction": round(
+                        cache.hits / probes, 4) if probes else 0.0,
+                    "steady_hit_fraction": steady.get(
+                        "prefix_hit_fraction", 0.0),
+                }, server, router, manager
+            except BaseException:
+                server.stop(drain=False)
+                raise
+
+        warm_cycle, server, router, manager = _elastic_cycle(warm=True)
+        # Scale cycle on the surviving warm server: a synthetic pressure
+        # source drives the real autoscaler control law; replica count
+        # must be monotone within each phase (no oscillation).
+        pressure = [0.95]
+        scaler = Autoscaler(
+            manager, pressure_fn=lambda: pressure[0],
+            min_replicas=1, max_replicas=4,
+            up_dwell_s=0.1, down_dwell_s=0.2, cooldown_s=0.1,
+            check_interval_s=0.05, registry=_Registry(),
+        )
+        try:
+            sizes_up = []
+            t_up = time.perf_counter()
+            _wait(lambda: sizes_up.append(len(router.replicas)) or (
+                len(router.replicas) == 4
+                and router.stats()["fleet"]["healthy"] == 4), 10.0)
+            scale_up_s = time.perf_counter() - t_up
+            pressure[0] = 0.1
+            sizes_down = []
+            t_down = time.perf_counter()
+            _wait(lambda: sizes_down.append(len(router.replicas)) or (
+                len(router.replicas) == 3), 10.0)
+            scale_down_s = time.perf_counter() - t_down
+            monotone = (
+                sizes_up == sorted(sizes_up)
+                and sizes_down == sorted(sizes_down, reverse=True)
+            )
+            scale_snapshot = scaler.snapshot()
+        finally:
+            scaler.close()
+            server.stop(drain=False)
+
+        cold_cycle, server, _, _ = _elastic_cycle(warm=False)
+        server.stop(drain=False)
+
+        warm_prefill = warm_cycle["replay_prefill_tokens"]
+        cold_prefill = cold_cycle["replay_prefill_tokens"]
+        elastic_extra = {"bench_elastic": {
+            "availability": min(warm_cycle["steady_availability"],
+                                warm_cycle["replay_availability"]),
+            "time_to_recover_s": warm_cycle["time_to_recover_s"],
+            "respawns": warm_cycle["respawns"],
+            "respawn_prefill_tokens": {
+                "warm": warm_prefill, "cold": cold_prefill,
+            },
+            "warm_vs_cold_prefill_ratio": round(
+                cold_prefill / warm_prefill, 2) if warm_prefill else None,
+            "respawn_hit_fraction": {
+                "warm": warm_cycle["respawn_hit_fraction"],
+                "cold": cold_cycle["respawn_hit_fraction"],
+            },
+            "steady_hit_fraction": warm_cycle["steady_hit_fraction"],
+            "scale_up_s": round(scale_up_s, 3),
+            "scale_down_s": round(scale_down_s, 3),
+            "scale_events": {"up": scale_snapshot["scale_ups"],
+                             "down": scale_snapshot["scale_downs"]},
+            "replica_count_monotone": monotone,
+            "requests_per_phase": el_requests,
+            "offered_rate_rps": el_rate,
+            "goal": "availability 1.0 through kill->respawn->scale cycle; "
+                    "warm respawn prefills less than cold (PageStore "
+                    "handoff); replica count monotone per phase",
+        }}
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -933,6 +1092,7 @@ def main() -> None:
                     **prefix_extra,
                     **mesh_extra,
                     **score_extra,
+                    **elastic_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

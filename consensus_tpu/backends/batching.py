@@ -84,7 +84,7 @@ class BatchingBackend:
         flush_ms: float = 10.0,
         expected_sessions: int = 1,
         registry: Optional[Registry] = None,
-        engine: bool = False,
+        engine: bool = True,
         engine_options: Optional[Dict[str, Any]] = None,
         prefix_cache: bool = False,
         mesh: Optional[Any] = None,
@@ -201,8 +201,10 @@ class BatchingBackend:
         #: loop and the whole flush-snapshot path above is UNREACHABLE —
         #: no quiescence windows, so ``flush_reason="timeout"`` can never
         #: be emitted and ``batching_spurious_wakeups_total`` stays pinned
-        #: at 0 (there are no parked flush waiters to wake).  The legacy
-        #: path stays the constructor default for one release.
+        #: at 0 (there are no parked flush waiters to wake).  The engine IS
+        #: the constructor default now; ``engine=False`` is the explicit
+        #: opt-out for the legacy flush-snapshot path (kept for A/B benches
+        #: and the flush-semantics tests).
         self.engine = None
         if engine:
             from consensus_tpu.backends.engine import DecodeEngine

@@ -55,6 +55,7 @@ module load).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from consensus_tpu.backends.base import (
@@ -172,6 +173,7 @@ class DecodeEngine:
         prefix_cache: bool = False,
         prefix_cache_pages: Optional[int] = None,
         mesh: Optional[Any] = None,
+        watchdog_timeout_s: Optional[float] = None,
     ):
         self.inner = inner
         self.n_slots = max(1, int(slots))
@@ -315,6 +317,17 @@ class DecodeEngine:
             "identical (prompt, continuation) rows in one flush are "
             "computed once and fanned back out.",
         )
+        self._m_watchdog_trips = reg.counter(
+            "engine_watchdog_trips_total",
+            "Hang-watchdog trips: a dispatched inner-backend call made no "
+            "progress for watchdog_timeout_s, so the engine latched "
+            "backend_lost (the silent-hang -> recoverable-loss conversion).",
+        )
+        self._m_heartbeat_age = reg.gauge(
+            "engine_heartbeat_age_s",
+            "Seconds since the decode engine's iteration loop last proved "
+            "liveness (sampled by the watchdog monitor thread).",
+        )
         #: Queued-call cancellations share the batching adapter's counter
         #: family so PR 1 dashboards keep one cancellation series.
         self._cancelled_counter = cancelled_counter
@@ -342,6 +355,23 @@ class DecodeEngine:
         #: contract).  Fleet replica health checks read this directly — a
         #: plain bool read, no lock — as the passive loss signal.
         self.backend_lost = False
+        #: Hang watchdog (the one failure mode the fault taxonomy cannot
+        #: raise its way out of): ``run_iteration`` stamps a heartbeat and
+        #: marks the lock-free dispatch window busy; a monitor thread trips
+        #: when a dispatch has been in flight for ``watchdog_timeout_s``
+        #: without returning, latching ``backend_lost`` so the fleet health
+        #: ladder (and ReplicaManager respawn) treat the wedge exactly like
+        #: a device loss.  ``wedged`` records that the loss came from the
+        #: watchdog, not an exception.
+        self.watchdog_timeout_s = (
+            float(watchdog_timeout_s) if watchdog_timeout_s else None
+        )
+        self.wedged = False
+        self.watchdog_trips = 0
+        self._busy_since: Optional[float] = None
+        self._heartbeat = time.monotonic()
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread: Optional[threading.Thread] = None
         self.iterations = 0
         self._occ_sum = 0.0
         self._occ_iters = 0
@@ -354,6 +384,14 @@ class DecodeEngine:
                 target=self._loop, name="decode-engine", daemon=True
             )
             self._thread.start()
+        # The monitor runs whenever a timeout is configured — including
+        # auto_start=False test engines stepped via run_iteration().
+        if self.watchdog_timeout_s:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, name="engine-watchdog",
+                daemon=True,
+            )
+            self._watchdog_thread.start()
 
     # -- public ------------------------------------------------------------
 
@@ -382,8 +420,14 @@ class DecodeEngine:
         with self._work:
             self._stopped = True
             self._work.notify_all()
+        self._watchdog_stop.set()
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout=5.0)
+        if (
+            self._watchdog_thread is not None
+            and self._watchdog_thread.is_alive()
+        ):
+            self._watchdog_thread.join(timeout=1.0)
 
     def track_session(self, session, spec):
         """Seam for ``open_token_search``: fused sessions bypass the request
@@ -448,6 +492,15 @@ class DecodeEngine:
                 "fused_search_sessions": self._search_sessions,
                 "fused_search_slots": self._search_slots,
                 "backend_lost": self.backend_lost,
+                "watchdog": {
+                    "enabled": self.watchdog_timeout_s is not None,
+                    "timeout_s": self.watchdog_timeout_s,
+                    "heartbeat_age_s": round(
+                        max(0.0, time.monotonic() - self._heartbeat), 4
+                    ),
+                    "wedged": self.wedged,
+                    "trips": self.watchdog_trips,
+                },
                 "prefix_cache": prefix_block,
                 "mesh": {
                     "dp": self.mesh_dp,
@@ -503,6 +556,7 @@ class DecodeEngine:
     def run_iteration(self) -> None:
         """One scheduler iteration.  Public so tests can step the engine
         deterministically (construct with ``auto_start=False``)."""
+        self._heartbeat = time.monotonic()
         with self._lock:
             self._process_cancellations()
             self._admit()
@@ -525,10 +579,39 @@ class DecodeEngine:
         # enqueueing while the device is busy, so the next iteration's
         # cohort and merged kind-batches widen for free (the same overlap
         # the legacy flush got from releasing its lock mid-dispatch).
-        if cohort:
-            self._dispatch_decode(cohort)
-        for kind, items in others.items():
-            self._dispatch_other(kind, items)
+        # The busy window brackets exactly the calls that can silently
+        # wedge — a dispatch that never returns leaves ``_busy_since`` set
+        # and the watchdog converts the stall into ``backend_lost``.
+        if cohort or others:
+            self._busy_since = time.monotonic()
+        try:
+            if cohort:
+                self._dispatch_decode(cohort)
+            for kind, items in others.items():
+                self._dispatch_other(kind, items)
+        finally:
+            self._busy_since = None
+            self._heartbeat = time.monotonic()
+
+    def _watchdog_loop(self) -> None:
+        """Monitor thread: trip when a dispatched inner call has made no
+        progress for ``watchdog_timeout_s``.  Idle engines never trip —
+        staleness only counts while the busy window is open, so a quiet
+        fleet replica is indistinguishable from a healthy one."""
+        interval = max(0.01, self.watchdog_timeout_s / 4.0)
+        while not self._watchdog_stop.wait(interval):
+            now = time.monotonic()
+            self._m_heartbeat_age.set(max(0.0, now - self._heartbeat))
+            busy = self._busy_since
+            if (
+                not self.wedged
+                and busy is not None
+                and now - busy > self.watchdog_timeout_s
+            ):
+                self.wedged = True
+                self.backend_lost = True
+                self.watchdog_trips += 1
+                self._m_watchdog_trips.inc()
 
     # -- iteration phases (lock held) ---------------------------------------
 

@@ -20,6 +20,13 @@ Fault kinds (``FaultSpec.kind``):
 * ``device_lost`` — from the firing call onward, EVERY call raises
   :class:`~consensus_tpu.backends.base.BackendLostError` (a preempted TPU
   does not come back).
+* ``hang`` — block the call FOREVER (until :meth:`release_hangs`): the one
+  failure mode nothing above can classify, because nothing raises.  A hung
+  XLA collective or wedged host runtime looks exactly like this — the call
+  simply never returns — and it is what the decode engine's hang watchdog
+  exists to convert into a recoverable ``backend_lost``.  Hung threads are
+  daemon threads by serving convention; tests call ``release_hangs()`` at
+  teardown to unstick them.
 
 Firing is per-op and per-call-index: ``call_index`` pins a spec to the
 N-th call of that op (exact), ``after_s`` pins it to the first matching
@@ -64,6 +71,7 @@ FAULT_KINDS = (
     "truncate",
     "latency",
     "device_lost",
+    "hang",
 )
 
 
@@ -206,6 +214,10 @@ class FaultInjectingBackend:
         self._lock = threading.Lock()
         self._call_index = {op: 0 for op in OPS}
         self._device_lost = False
+        # ``hang`` faults park the calling thread on this event; it starts
+        # unset (block forever) and ``release_hangs()`` sets it for good.
+        self._hang_release = threading.Event()
+        self.hangs_active = 0
         reg = registry if registry is not None else get_registry()
         self._injected = reg.counter(
             "faults_injected_total",
@@ -257,9 +269,32 @@ class FaultInjectingBackend:
                 raise TimeoutError(
                     f"injected timeout (op={op}, call={index})"
                 )
+            elif spec.kind == "hang":
+                # Block until released — the silent-hang failure mode.  The
+                # caller's thread parks here with no exception for anything
+                # above to classify; only the decode engine's heartbeat
+                # watchdog can observe the wedge.  After release the call
+                # proceeds normally (the hang was transient from the
+                # caller's perspective — but the watchdog has long since
+                # declared the replica lost).
+                self._injected.labels("hang", op).inc()
+                with self._lock:
+                    self.hangs_active += 1
+                try:
+                    self._hang_release.wait()
+                finally:
+                    with self._lock:
+                        self.hangs_active -= 1
             else:
                 post.append(spec)
         return post
+
+    def release_hangs(self) -> None:
+        """Unstick every thread parked (now or later) on a ``hang`` fault.
+
+        Irreversible by design: tests call this at teardown so hung daemon
+        threads do not outlive the test holding shared state."""
+        self._hang_release.set()
 
     def _target_rows(self, spec: FaultSpec, n: int) -> List[int]:
         if spec.row_index is None:

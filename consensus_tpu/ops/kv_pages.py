@@ -39,7 +39,7 @@ import dataclasses
 import hashlib
 import threading
 from collections import OrderedDict
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -240,11 +240,17 @@ class BlockTable:
 
 
 class _PrefixEntry:
-    __slots__ = ("pages", "n_tokens")
+    __slots__ = ("pages", "n_tokens", "tokens")
 
-    def __init__(self, pages: List[int], n_tokens: int):
+    def __init__(self, pages: List[int], n_tokens: int, tokens: Tuple = ()):
         self.pages = pages
         self.n_tokens = n_tokens
+        #: The token prefix itself — retained so a run can be EXPORTED
+        #: (serve/pagestore.py warm handoff) and re-inserted into another
+        #: replica's cache, which needs the tokens to rebuild the chained
+        #: content keys.  Token ids are small ints/strs; the KV bytes they
+        #: key are the heavy payload and those stay on device.
+        self.tokens = tokens
 
 
 class PrefixCache:
@@ -275,7 +281,12 @@ class PrefixCache:
     ):
         self.pool = pool
         self.max_pages = max(0, int(max_pages))
-        self._seed = repr(tuple(identity)).encode()
+        #: Model-tier/quant identity the content keys are seeded with —
+        #: exposed so the warm-handoff PageStore can refuse to adopt runs
+        #: across mismatched identities (different model or tp width ==
+        #: different KV bytes, same tokens notwithstanding).
+        self.identity = tuple(identity)
+        self._seed = repr(self.identity).encode()
         self._entries: "OrderedDict[bytes, _PrefixEntry]" = OrderedDict()
         self._pages_cached = 0
         self._lock = threading.Lock()
@@ -331,7 +342,9 @@ class PrefixCache:
                 self._entries.move_to_end(key)
                 return False
             self.pool.share(pages)
-            self._entries[key] = _PrefixEntry(list(pages), n_pages * ps)
+            self._entries[key] = _PrefixEntry(
+                list(pages), n_pages * ps, tokens=tuple(tokens)
+            )
             self._pages_cached += n_pages
             self.inserted_pages += n_pages
             while self.max_pages and self._pages_cached > self.max_pages:
@@ -340,6 +353,32 @@ class PrefixCache:
                 self._pages_cached -= len(old.pages)
                 self.evictions += 1
             return True
+
+    def export_runs(
+        self, max_runs: Optional[int] = None
+    ) -> List[Dict[str, object]]:
+        """Snapshot the hottest cached runs for warm handoff, most recently
+        used FIRST: each run carries the tokens (to rebuild chained keys on
+        the importing side), its final chained content key, the page ids it
+        occupies HERE (device-local — meaningful only to a backend that can
+        serialize those pages' KV bytes), and the block-table metadata a
+        joining replica needs to re-admit it.  No references are taken —
+        the export is a point-in-time read; the PageStore's payload capture
+        happens in the same harvest pass, before any eviction could free
+        the pages."""
+        with self._lock:
+            runs: List[Dict[str, object]] = []
+            for key, entry in reversed(self._entries.items()):
+                if max_runs is not None and len(runs) >= max_runs:
+                    break
+                runs.append({
+                    "key": key,
+                    "tokens": tuple(entry.tokens),
+                    "n_tokens": entry.n_tokens,
+                    "pages": list(entry.pages),
+                    "page_size": self.pool.page_size,
+                })
+            return runs
 
     def clear(self) -> None:
         with self._lock:

@@ -14,9 +14,11 @@ traffic").  The layering, front to back:
 server; ``serve.loadgen`` replays AAMAS scenarios against it.
 """
 
+from consensus_tpu.serve.autoscale import Autoscaler  # noqa: F401
 from consensus_tpu.serve.brownout import BrownoutController  # noqa: F401
-from consensus_tpu.serve.fleet import Replica  # noqa: F401
+from consensus_tpu.serve.fleet import Replica, ReplicaManager  # noqa: F401
 from consensus_tpu.serve.http_frontend import ConsensusServer  # noqa: F401
+from consensus_tpu.serve.pagestore import PageStore  # noqa: F401
 from consensus_tpu.serve.router import FleetRouter, FleetTicket  # noqa: F401
 from consensus_tpu.serve.scheduler import (  # noqa: F401
     RequestScheduler,
@@ -49,7 +51,7 @@ def create_server(
     brownout: bool = False,
     target_p95_ms=None,
     anytime_margin_s: float = 0.2,
-    engine: bool = False,
+    engine: bool = True,
     engine_options=None,
     fleet_size: int = 1,
     fleet_options=None,
@@ -67,10 +69,11 @@ def create_server(
     pressure, newly dispatched requests run at a scaled-down search budget
     (responses tagged ``degraded``) instead of queueing into 504s.
     ``target_p95_ms`` adds a latency-SLO term to the pressure signal.
-    ``engine=True`` swaps the scheduler's merge layer from the legacy
-    flush-snapshot BatchingBackend to the continuous-batching decode
-    engine (``--engine`` on the CLI): same byte-identical results, no
-    flush barrier, and /healthz gains slot-table + KV-page-pool pressure.
+    The continuous-batching decode engine is the DEFAULT merge layer:
+    byte-identical results to the legacy flush path, no flush barrier,
+    and /healthz gains slot-table + KV-page-pool pressure.
+    ``engine=False`` (``--no-engine`` on the CLI) opts back into the
+    legacy flush-snapshot BatchingBackend.
 
     ``fleet_size > 1`` (or any ``fleet_options``) builds N full replica
     stacks — each with its OWN backend instance, kill switch, supervisor +
@@ -86,6 +89,19 @@ def create_server(
     ``hedge_after_s``, ``probe_interval_s``, ``probe_timeout_s``,
     ``tier_enter_pressure``, ``tier_exit_pressure``, ``tier_min_dwell_s``.
 
+    Elastic fleets (``fleet_options["elastic"]=True``) additionally get a
+    :class:`~consensus_tpu.serve.fleet.ReplicaManager` (respawn lost
+    replicas under the same name with warm prefix-KV handoff through a
+    fleet :class:`~consensus_tpu.serve.pagestore.PageStore`, flap
+    quarantine, target-count reconciliation; knobs via
+    ``fleet_options["elastic_options"]``) and, with
+    ``fleet_options["autoscale"]`` (True or an options dict), an
+    :class:`~consensus_tpu.serve.autoscale.Autoscaler` driving the
+    manager's target from brownout pressure.
+    ``fleet_options["watchdog_timeout_s"]`` arms each replica engine's
+    hang watchdog (a dispatch wedged that long latches ``backend_lost``,
+    so the ladder — and the manager — treat the hang as a loss).
+
     With ``fleet_size=1`` and no ``fleet_options`` the router is bypassed
     entirely — the server runs the exact single-scheduler path below, so
     responses stay byte-identical to that path (pinned in
@@ -97,8 +113,9 @@ def create_server(
     and page pools over the dp replicas (``--mesh`` on the CLI).  Non-TPU
     backends only see the engine-side partitioning.
 
-    Defaults OFF so a quiet server's responses stay byte-identical to
-    offline Experiment runs (pinned in tests/test_serve.py)."""
+    Resilience/brownout/fleet features default OFF so a quiet server's
+    responses stay byte-identical to offline Experiment runs (pinned in
+    tests/test_serve.py — the engine default keeps that identity)."""
     from consensus_tpu.backends import get_backend, wrap_backend
 
     if mesh is not None:
@@ -197,9 +214,12 @@ def _create_fleet_server(
     the router's passive health signal), and optionally its own brownout
     controller.  Scalar ``fault_plan`` arms every replica identically;
     ``fleet_options["fault_plans"]`` is a per-replica list (``None``
-    entries = no chaos on that replica).
+    entries = no chaos on that replica).  Chaos plans arm a replica's
+    FIRST life only: a respawned name gets a clean backend, so a
+    deterministic kill cannot respawn-loop the fleet into quarantine.
     """
     from consensus_tpu.backends import get_backend
+    from consensus_tpu.serve.fleet import _name_index
 
     tiers = fleet_options.get("tiers")
     if tiers is not None and len(tiers) != fleet_size:
@@ -217,10 +237,25 @@ def _create_fleet_server(
     engines = fleet_options.get("engine")
     if engines is not None and not isinstance(engines, (list, tuple)):
         engines = [engines] * fleet_size
+    watchdog_timeout_s = fleet_options.get("watchdog_timeout_s")
+    if watchdog_timeout_s is not None:
+        engine_options = {
+            "watchdog_timeout_s": watchdog_timeout_s,
+            **dict(engine_options or {}),
+        }
 
-    replicas = []
-    for i in range(fleet_size):
-        tier = tiers[i] if tiers is not None else "full"
+    built = set()  # names whose first life already consumed its fault plan
+
+    def replica_factory(name, tier=None):
+        """Build one UNSTARTED replica stack.  Used for the initial fleet
+        AND by the ReplicaManager for respawns/scale-ups — the one place
+        the full stack recipe lives."""
+        i = _name_index(name)
+        if tier is None:
+            tier = (
+                tiers[i] if tiers is not None and 0 <= i < len(tiers)
+                else "full"
+            )
         options = dict(backend_options or {})
         options.update(tier_backend_options.get(tier, {}))
         inner = get_backend(backend, fresh=True, **options)
@@ -232,29 +267,40 @@ def _create_fleet_server(
                 ),
                 registry=registry,
             )
-        plan = fault_plans[i] if fault_plans is not None else fault_plan
-        replicas.append(
-            Replica(
-                name=f"r{i}",
-                backend=inner,
-                tier=tier,
-                registry=registry,
-                fault_plan=plan,
-                supervise=supervise if supervise is not None else True,
-                brownout=controller,
-                generation_model=generation_model,
-                scheduler_options={
-                    "max_queue_depth": max_queue_depth,
-                    "max_inflight": max_inflight,
-                    "default_timeout_s": default_timeout_s,
-                    "max_retries": max_retries,
-                    "flush_ms": flush_ms,
-                    "anytime_margin_s": anytime_margin_s,
-                    "engine": engines[i] if engines is not None else engine,
-                    "engine_options": engine_options,
-                },
+        plan = None
+        if name not in built:
+            built.add(name)
+            plan = (
+                fault_plans[i]
+                if fault_plans is not None and 0 <= i < len(fault_plans)
+                else fault_plan
             )
+        engine_flag = (
+            engines[i] if engines is not None and 0 <= i < len(engines)
+            else engine
         )
+        return Replica(
+            name=name,
+            backend=inner,
+            tier=tier,
+            registry=registry,
+            fault_plan=plan,
+            supervise=supervise if supervise is not None else True,
+            brownout=controller,
+            generation_model=generation_model,
+            scheduler_options={
+                "max_queue_depth": max_queue_depth,
+                "max_inflight": max_inflight,
+                "default_timeout_s": default_timeout_s,
+                "max_retries": max_retries,
+                "flush_ms": flush_ms,
+                "anytime_margin_s": anytime_margin_s,
+                "engine": engine_flag,
+                "engine_options": engine_options,
+            },
+        )
+
+    replicas = [replica_factory(f"r{i}") for i in range(fleet_size)]
     router = FleetRouter(
         replicas,
         registry=registry,
@@ -266,4 +312,30 @@ def _create_fleet_server(
         tier_exit_pressure=fleet_options.get("tier_exit_pressure", 0.5),
         tier_min_dwell_s=fleet_options.get("tier_min_dwell_s", 2.0),
     )
+
+    autoscale = fleet_options.get("autoscale")
+    if fleet_options.get("elastic") or autoscale:
+        from consensus_tpu.serve.autoscale import Autoscaler
+        from consensus_tpu.serve.fleet import ReplicaManager
+        from consensus_tpu.serve.pagestore import PageStore
+
+        elastic_options = dict(fleet_options.get("elastic_options") or {})
+        store = PageStore(
+            max_runs=elastic_options.pop("page_store_runs", 256),
+            registry=registry,
+        )
+        manager = ReplicaManager(
+            router,
+            replica_factory,
+            page_store=store,
+            registry=registry,
+            **elastic_options,
+        )
+        if autoscale:
+            autoscale_options = (
+                dict(autoscale) if isinstance(autoscale, dict) else {}
+            )
+            autoscale_options.setdefault("max_replicas", fleet_size * 2)
+            Autoscaler(manager, registry=registry, **autoscale_options)
+
     return ConsensusServer(router, host=host, port=port, registry=registry)

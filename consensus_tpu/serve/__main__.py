@@ -60,11 +60,13 @@ def main(argv=None) -> int:
     parser.add_argument("--target-p95-ms", type=float, default=None,
                         help="latency SLO fed into the brownout pressure "
                              "signal (implies --brownout)")
-    parser.add_argument("--engine", action="store_true",
+    parser.add_argument("--engine", action=argparse.BooleanOptionalAction,
+                        default=True,
                         help="serve through the continuous-batching decode "
-                             "engine (slot table + paged KV cache) instead "
-                             "of the legacy flush-snapshot merge; results "
-                             "are byte-identical")
+                             "engine (slot table + paged KV cache) — the "
+                             "default; --no-engine opts back into the "
+                             "legacy flush-snapshot merge (results are "
+                             "byte-identical either way)")
     parser.add_argument("--engine-options", default="{}",
                         help="JSON object of DecodeEngine kwargs (e.g. "
                              '\'{"slots": 16, "page_size": 16}\')')
@@ -79,7 +81,25 @@ def main(argv=None) -> int:
                              "tier_backend_options, hedge_after_s, "
                              "probe_timeout_s, engine (per-replica list — "
                              "legacy flush vs --engine is chosen per "
-                             "replica), ... (see create_server docs)")
+                             "replica), elastic, elastic_options, "
+                             "autoscale, watchdog_timeout_s, ... (see "
+                             "create_server docs)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="(fleet) run the replica lifecycle manager: "
+                             "lost replicas respawn under their old name "
+                             "with warm PageStore prefix pages, flapping "
+                             "ones are quarantined")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="(fleet) run the pressure-driven autoscaler "
+                             "on top of the lifecycle manager (implies "
+                             "--elastic); scales the replica target on "
+                             "brownout pressure before quality degrades")
+    parser.add_argument("--watchdog-timeout-s", type=float, default=None,
+                        metavar="S",
+                        help="(fleet) arm each replica engine's hang "
+                             "watchdog: a device dispatch wedged longer "
+                             "than S marks the replica lost and the "
+                             "elastic ladder respawns it")
     parser.add_argument("--mesh", default=None, metavar="dp=N,tp=M",
                         help="serve over the (data, model) device mesh: "
                              "shard TPU backend params Megatron-style over "
@@ -94,6 +114,14 @@ def main(argv=None) -> int:
     )
 
     from consensus_tpu.serve import create_server
+
+    fleet_options = json.loads(args.fleet_options) or {}
+    if args.elastic or args.autoscale:
+        fleet_options.setdefault("elastic", True)
+    if args.autoscale:
+        fleet_options.setdefault("autoscale", True)
+    if args.watchdog_timeout_s is not None:
+        fleet_options.setdefault("watchdog_timeout_s", args.watchdog_timeout_s)
 
     server = create_server(
         backend=args.backend,
@@ -111,7 +139,7 @@ def main(argv=None) -> int:
         engine=args.engine,
         engine_options=json.loads(args.engine_options),
         fleet_size=args.fleet,
-        fleet_options=json.loads(args.fleet_options) or None,
+        fleet_options=fleet_options or None,
         mesh=args.mesh,
     )
     stop = threading.Event()
@@ -134,6 +162,9 @@ def main(argv=None) -> int:
         "brownout": args.brownout or args.target_p95_ms is not None,
         "engine": args.engine,
         "fleet": args.fleet,
+        "elastic": bool(fleet_options.get("elastic")
+                        or fleet_options.get("autoscale")),
+        "autoscale": bool(fleet_options.get("autoscale")),
         "mesh": args.mesh,
     }))
     try:

@@ -34,7 +34,8 @@ skipped while healthier peers exist.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional
 
 from consensus_tpu.backends.base import Backend, BackendLostError
 from consensus_tpu.obs.metrics import Registry, get_registry
@@ -281,3 +282,318 @@ class Replica:
             if key in stats:
                 snap[key] = stats[key]
         return snap
+
+
+def _name_index(name: str) -> int:
+    """Numeric suffix of a replica name (``r12`` -> 12); -1 when absent.
+    Spawn naming and scale-down victim selection both key on it."""
+    digits = ""
+    for ch in reversed(name):
+        if ch.isdigit():
+            digits = ch + digits
+        else:
+            break
+    return int(digits) if digits else -1
+
+
+class ReplicaManager:
+    """Replica lifecycle: respawn lost members, reconcile a target count,
+    and warm-hand prefix KV over the replica seam.
+
+    The health ladder (above) DETECTS loss; this layer makes loss
+    recoverable.  A monitor thread runs three duties per tick:
+
+    1. **Harvest** — healthy replicas' prefix caches are captured into the
+       fleet :class:`~consensus_tpu.serve.pagestore.PageStore` on a
+       bounded cadence, so the store always holds a recent snapshot of the
+       fleet's hottest page runs (a replica's last harvest survives its
+       death — that is the whole point).
+    2. **Respawn** — a member whose ladder latched ``lost`` is removed
+       from the router immediately, its corpse retired on a background
+       thread (``drain=False`` with a short timeout: a wedged worker must
+       not block the fleet), and a fresh stack is built by the
+       ``replica_factory`` under the SAME name after a bounded exponential
+       backoff — same name means rendezvous hashing restores the exact
+       pre-loss scenario mapping, so the warm pages seeded from the store
+       land where their scenarios route.  A flap detector quarantines a
+       name that dies ``flap_threshold`` times within ``flap_window_s``
+       instead of respawn-looping it; quarantined slots are NOT backfilled
+       (the effective target shrinks) until an operator calls
+       :meth:`clear_quarantine` — a flapping unit signals a fault no fresh
+       stack will outrun.
+    3. **Reconcile** — live-plus-pending membership converges on
+       ``target`` (driven by the autoscaler or :meth:`set_target`):
+       scale-up spawns fresh names seeded warm from the store; scale-down
+       retires the highest-numbered healthy member with a full drain.
+
+    ``replica_factory(name, tier)`` must return an UNSTARTED
+    :class:`Replica` over a fresh backend instance; the manager starts it,
+    seeds its engine's prefix caches from the store, and only then
+    registers it with the router — a joining replica never takes traffic
+    cold.
+    """
+
+    def __init__(
+        self,
+        router,
+        replica_factory: Callable[[str, Optional[str]], Replica],
+        *,
+        page_store=None,
+        registry: Optional[Registry] = None,
+        respawn_backoff_s: float = 0.25,
+        respawn_backoff_max_s: float = 5.0,
+        flap_window_s: float = 30.0,
+        flap_threshold: int = 3,
+        check_interval_s: float = 0.2,
+        harvest_interval_s: float = 0.5,
+        retire_timeout_s: float = 2.0,
+        auto_start: bool = True,
+        clock=time.monotonic,
+    ):
+        self.router = router
+        self.factory = replica_factory
+        self.page_store = page_store
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.respawn_backoff_max_s = float(respawn_backoff_max_s)
+        self.flap_window_s = float(flap_window_s)
+        self.flap_threshold = max(1, int(flap_threshold))
+        self.check_interval_s = float(check_interval_s)
+        self.harvest_interval_s = float(harvest_interval_s)
+        self.retire_timeout_s = float(retire_timeout_s)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self.target = len(router.replicas)
+        self.respawns = 0
+        self.losses = 0
+        self._loss_times: Dict[str, List[float]] = {}
+        self._backoffs: Dict[str, float] = {}
+        #: name -> (due time, tier) for pending respawns.
+        self._pending: Dict[str, Any] = {}
+        self._quarantined: Dict[str, str] = {}
+        self._last_harvest = 0.0
+        self._next_index = 1 + max(
+            (_name_index(r.name) for r in router.replicas), default=-1
+        )
+
+        reg = registry if registry is not None else get_registry()
+        self._m_respawns = reg.counter(
+            "fleet_respawns_total",
+            "Lost replicas replaced with a fresh stack under the same "
+            "name (warm-seeded from the PageStore when one is attached).",
+        )
+        self._m_quarantined = reg.counter(
+            "fleet_quarantined_total",
+            "Replica names quarantined by the flap detector (>= threshold "
+            "losses inside the window) instead of respawned.",
+        )
+        self._m_target = reg.gauge(
+            "fleet_target_replicas",
+            "Replica count the lifecycle manager is converging the fleet "
+            "toward (autoscaler-driven when one is attached).",
+        )
+        self._m_target.set(self.target)
+
+        router.manager = self
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self._thread = threading.Thread(
+                target=self._loop, name="replica-manager", daemon=True
+            )
+            self._thread.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - monitor must survive
+                pass
+
+    # -- control surface ----------------------------------------------------
+
+    def set_target(self, n: int) -> int:
+        """Desired replica count (autoscaler / operator).  Clamped to >= 1;
+        reconciliation happens on the next tick."""
+        with self._lock:
+            self.target = max(1, int(n))
+            self._m_target.set(self.target)
+            return self.target
+
+    def clear_quarantine(self, name: str) -> bool:
+        """Operator override: forget a name's flap history and schedule an
+        immediate respawn for it."""
+        with self._lock:
+            if name not in self._quarantined:
+                return False
+            del self._quarantined[name]
+            self._loss_times.pop(name, None)
+            self._backoffs.pop(name, None)
+            self._pending[name] = (self._clock(), None)
+            return True
+
+    # -- monitor duties -----------------------------------------------------
+
+    def tick(self) -> None:
+        """One monitor pass (public so tests can step deterministically)."""
+        now = self._clock()
+        self._harvest(now)
+        self._detect_losses(now)
+        self._process_pending(now)
+        self._reconcile(now)
+
+    def _harvest(self, now: float) -> None:
+        if self.page_store is None:
+            return
+        if now - self._last_harvest < self.harvest_interval_s:
+            return
+        self._last_harvest = now
+        for replica in self.router.replicas:
+            if replica.lost:
+                continue
+            engine = replica.scheduler.batching.engine
+            if engine is not None:
+                try:
+                    self.page_store.capture_engine(engine)
+                except Exception:
+                    # A replica dying mid-harvest is the loss path's
+                    # problem, not the harvester's.
+                    continue
+
+    def _detect_losses(self, now: float) -> None:
+        for replica in self.router.replicas:
+            if not replica.lost:
+                continue
+            corpse = self.router.remove_replica(replica.name)
+            if corpse is None:
+                continue
+            self._retire_async(corpse, drain=False)
+            with self._lock:
+                self.losses += 1
+                history = [
+                    t for t in self._loss_times.get(replica.name, [])
+                    if now - t <= self.flap_window_s
+                ]
+                history.append(now)
+                self._loss_times[replica.name] = history
+                if len(history) >= self.flap_threshold:
+                    self._quarantined[replica.name] = (
+                        f"{len(history)} losses in {self.flap_window_s:g}s"
+                    )
+                    self._pending.pop(replica.name, None)
+                    self._m_quarantined.inc()
+                    continue
+                backoff = self._backoffs.get(
+                    replica.name, self.respawn_backoff_s
+                )
+                self._backoffs[replica.name] = min(
+                    backoff * 2.0, self.respawn_backoff_max_s
+                )
+                self._pending[replica.name] = (now + backoff, corpse.tier)
+
+    def _process_pending(self, now: float) -> None:
+        with self._lock:
+            due = [
+                (name, tier) for name, (at, tier) in self._pending.items()
+                if now >= at
+            ]
+            for name, _ in due:
+                del self._pending[name]
+        for name, tier in due:
+            try:
+                self._spawn(name, tier, respawn=True)
+            except Exception:
+                # Factory failure: back off and try again — a transient
+                # (e.g. the replaced backend still tearing down) must not
+                # permanently shrink the fleet.
+                with self._lock:
+                    backoff = self._backoffs.get(
+                        name, self.respawn_backoff_s)
+                    self._backoffs[name] = min(
+                        backoff * 2.0, self.respawn_backoff_max_s)
+                    self._pending[name] = (now + backoff, tier)
+
+    def _reconcile(self, now: float) -> None:
+        with self._lock:
+            effective_target = max(1, self.target - len(self._quarantined))
+            pending = len(self._pending)
+        live = [r for r in self.router.replicas if not r.lost]
+        have = len(live) + pending
+        if have < effective_target:
+            for _ in range(effective_target - have):
+                with self._lock:
+                    name = f"r{self._next_index}"
+                    self._next_index += 1
+                try:
+                    self._spawn(name, None, respawn=False)
+                except Exception:
+                    break
+        elif have > effective_target and live:
+            # Retire the newest (highest-numbered) healthy member with a
+            # full drain; in-flight work completes, then the stack closes.
+            victims = sorted(
+                (r for r in live if r.health == HEALTHY),
+                key=lambda r: _name_index(r.name),
+            )
+            for _ in range(min(have - effective_target, len(victims))):
+                victim = victims.pop()
+                removed = self.router.remove_replica(victim.name)
+                if removed is not None:
+                    self._retire_async(removed, drain=True)
+
+    # -- spawn / retire -----------------------------------------------------
+
+    def _spawn(self, name: str, tier: Optional[str],
+               respawn: bool) -> Replica:
+        replica = self.factory(name, tier)
+        replica.start()
+        if self.page_store is not None:
+            engine = replica.scheduler.batching.engine
+            if engine is not None:
+                try:
+                    self.page_store.seed_engine(engine)
+                except Exception:
+                    pass  # cold join is a degraded start, not a failure
+        self.router.add_replica(replica)
+        if respawn:
+            with self._lock:
+                self.respawns += 1
+            self._m_respawns.inc()
+        return replica
+
+    def _retire_async(self, corpse: Replica, drain: bool) -> None:
+        """Corpse teardown on a background thread: a wedged worker (the
+        hang the watchdog just converted to a loss) would otherwise block
+        the monitor for the full drain timeout."""
+        thread = threading.Thread(
+            target=corpse.shutdown,
+            kwargs={"drain": drain, "timeout": self.retire_timeout_s},
+            name=f"retire-{corpse.name}", daemon=True,
+        )
+        thread.start()
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "target": self.target,
+                "effective_target": max(
+                    1, self.target - len(self._quarantined)),
+                "respawns": self.respawns,
+                "losses": self.losses,
+                "pending_respawns": sorted(self._pending),
+                "quarantined": dict(self._quarantined),
+                "flap_threshold": self.flap_threshold,
+                "flap_window_s": self.flap_window_s,
+                "page_store": (
+                    self.page_store.stats()
+                    if self.page_store is not None else None
+                ),
+            }

@@ -301,6 +301,22 @@ def run_loadgen(
         report["fleet"]["affinity_hit_rate"] = fleet_after.get(
             "affinity_hit_rate", 0.0
         )
+        manager_after = fleet_after.get("manager")
+        if isinstance(manager_after, dict):
+            # Elastic fleet: respawns absorbed by the lifecycle manager
+            # over THIS run (counter delta), plus any members the flap
+            # detector quarantined — the report-level proof that a chaos
+            # run recovered by respawning rather than by shrinking.
+            manager_before = (
+                fleet_before.get("manager") if fleet_before else None
+            ) or {}
+            report["fleet"]["respawns"] = (
+                manager_after.get("respawns", 0)
+                - manager_before.get("respawns", 0)
+            )
+            report["fleet"]["quarantined"] = list(
+                manager_after.get("quarantined") or []
+            )
         report["replica_request_counts"] = replica_counts
         report["failover_fraction"] = (
             round(failovers / len(ok), 4) if ok else 0.0

@@ -1,0 +1,214 @@
+"""Host-side content-addressed store of prefix-KV page runs: the warm
+handoff seam between fleet replicas.
+
+A replica's :class:`~consensus_tpu.ops.kv_pages.PrefixCache` holds
+device-resident KV pages keyed by chained blake2b content keys over
+(model identity, page-aligned prompt-token prefix).  Those keys make KV
+state PORTABLE: any replica with the same ``kv_cache_identity()`` computes
+the same key for the same tokens, so a page run captured from one replica
+can be adopted by another — PagedAttention block tables plus
+RadixAttention content addressing taken across the replica seam.
+
+The store keeps, per run:
+
+* the chained content ``key`` (the run's identity within a model identity),
+* the ``tokens`` prefix (needed to rebuild the chain on the adopting side),
+* block-table metadata (``n_tokens``, ``page_size``, page count), and
+* the page PAYLOAD — raw KV bytes, captured via the backend's optional
+  ``export_kv_pages(page_ids)`` hook and restored via
+  ``import_kv_pages(page_ids, payload)``.  Backends without the hooks
+  (the fake backend, whose "KV" is derived deterministically from tokens)
+  store an empty payload: for them the tokens ARE the state, and adoption
+  reconstructs byte-identical results by construction — which is exactly
+  what the warm-handoff byte-identity test pins.
+
+Adoption rules (enforced in :meth:`seed_engine`):
+
+* identity must match the adopting cache's identity EXACTLY — a different
+  model tier, quantization mode, or tp width names different KV bytes for
+  the same tokens, and the store refuses (counted, never silent);
+* page_size must match the adopting pool's;
+* runs seed most-recently-captured first, so when the adopting cache's
+  LRU budget is smaller than the store, the hottest prefixes win.
+
+The :class:`~consensus_tpu.serve.fleet.ReplicaManager` harvests healthy
+replicas' caches into one fleet-wide store on its monitor cadence and
+pre-seeds every replica it spawns BEFORE registering it with the router —
+so a respawned replica's first requests hit warm prefixes instead of
+re-prefilling (the availability is the router's; the latency floor is
+this store's).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from consensus_tpu.obs.metrics import Registry, get_registry
+from consensus_tpu.ops.kv_pages import PagePoolExhausted
+
+#: Default bound on retained runs — LRU over capture recency.  Sized so a
+#: scenario-heavy loadgen run (dozens of distinct prompts) fits whole.
+DEFAULT_MAX_RUNS = 256
+
+
+class PageStore:
+    """Fleet-wide LRU of exported prefix-KV runs, keyed by
+    ``(kv_cache_identity, chained content key)``."""
+
+    def __init__(
+        self,
+        max_runs: int = DEFAULT_MAX_RUNS,
+        registry: Optional[Registry] = None,
+    ):
+        self.max_runs = max(1, int(max_runs))
+        self._lock = threading.Lock()
+        #: (identity, key) -> run dict; insertion order == capture recency
+        #: (move_to_end on re-capture), so iteration from the END yields
+        #: most-recently-seen first.
+        self._runs: "OrderedDict[Tuple[Tuple, bytes], Dict[str, Any]]" = (
+            OrderedDict()
+        )
+        reg = registry if registry is not None else get_registry()
+        self._m_captured = reg.counter(
+            "pagestore_runs_captured_total",
+            "Prefix-KV runs harvested from replica caches into the "
+            "fleet PageStore (re-captures of a known run count too).",
+        )
+        self._m_adopted = reg.counter(
+            "pagestore_runs_adopted_total",
+            "Stored runs adopted into a joining replica's prefix cache "
+            "(the warm-handoff seeding path).",
+        )
+        self._m_rejected = reg.counter(
+            "pagestore_identity_rejects_total",
+            "Runs refused at adoption because the joining cache's "
+            "kv_cache_identity (model tier / quant / tp width) did not "
+            "match the run's — mismatched identities name different KV "
+            "bytes for the same tokens.",
+        )
+        self._m_runs = reg.gauge(
+            "pagestore_runs",
+            "Prefix-KV runs currently retained by the fleet PageStore.",
+        )
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._runs)
+
+    # -- capture -------------------------------------------------------------
+
+    def capture_engine(self, engine: Any) -> int:
+        """Harvest every dp shard's prefix cache of ``engine``.  Returns
+        runs captured (including refreshes of already-known runs)."""
+        caches = getattr(engine, "prefix_caches", None) or []
+        inner = getattr(engine, "inner", None)
+        captured = 0
+        for cache in caches:
+            if cache is not None:
+                captured += self.capture_cache(cache, inner)
+        return captured
+
+    def capture_cache(self, cache: Any, inner: Any = None) -> int:
+        """Harvest one :class:`PrefixCache`'s runs.  ``inner`` is the
+        backend owning the cache's device pages; when it exposes
+        ``export_kv_pages(page_ids) -> bytes`` the run's payload is the
+        real KV bytes, otherwise the payload is empty and the tokens carry
+        the state (fake/CPU backends)."""
+        identity = tuple(getattr(cache, "identity", ()))
+        exporter = getattr(inner, "export_kv_pages", None)
+        captured = 0
+        for run in cache.export_runs():
+            payload = b""
+            if callable(exporter):
+                try:
+                    payload = exporter(run["pages"])
+                except Exception:
+                    # A replica dying mid-harvest must not poison the
+                    # store — skip the run, keep what we have.
+                    continue
+            with self._lock:
+                store_key = (identity, run["key"])
+                self._runs[store_key] = {
+                    "identity": identity,
+                    "key": run["key"],
+                    "tokens": tuple(run["tokens"]),
+                    "n_tokens": int(run["n_tokens"]),
+                    "page_size": int(run["page_size"]),
+                    "n_pages": len(run["pages"]),
+                    "payload": payload,
+                }
+                self._runs.move_to_end(store_key)
+                while len(self._runs) > self.max_runs:
+                    self._runs.popitem(last=False)
+                self._m_runs.set(len(self._runs))
+            captured += 1
+            self._m_captured.inc()
+        return captured
+
+    # -- adoption ------------------------------------------------------------
+
+    def seed_engine(self, engine: Any) -> int:
+        """Pre-seed a joining replica's prefix caches from the store,
+        hottest runs first, round-robin over the engine's dp shards (a
+        run's pages live in ONE shard's pool; spreading runs balances the
+        per-shard LRU budgets).  Returns runs adopted."""
+        caches = [
+            c for c in (getattr(engine, "prefix_caches", None) or [])
+            if c is not None
+        ]
+        if not caches:
+            return 0
+        inner = getattr(engine, "inner", None)
+        importer = getattr(inner, "import_kv_pages", None)
+        with self._lock:
+            runs = [dict(run) for run in reversed(self._runs.values())]
+        adopted = 0
+        shard = 0
+        for run in runs:
+            cache = caches[shard % len(caches)]
+            if tuple(run["identity"]) != tuple(cache.identity):
+                self._m_rejected.inc()
+                continue
+            if run["page_size"] != cache.pool.page_size:
+                self._m_rejected.inc()
+                continue
+            try:
+                pages = cache.pool.alloc(run["n_pages"], owner=self)
+            except PagePoolExhausted:
+                break
+            if cache.insert(run["tokens"], pages):
+                if callable(importer):
+                    try:
+                        importer(pages, run["payload"])
+                    except Exception:
+                        pass
+                adopted += 1
+                self._m_adopted.inc()
+                shard += 1
+            # Drop the seeding reference either way: on success the cache
+            # holds its own reference (pages stay resident); on a dup/
+            # over-budget refusal the pages go straight back to the pool.
+            cache.pool.free(pages)
+        return adopted
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            runs = list(self._runs.values())
+            identities = sorted({repr(r["identity"]) for r in runs})
+            return {
+                "runs": len(runs),
+                "max_runs": self.max_runs,
+                "pages": sum(r["n_pages"] for r in runs),
+                "tokens": sum(r["n_tokens"] for r in runs),
+                "payload_bytes": sum(len(r["payload"]) for r in runs),
+                "identities": identities,
+            }
+
+    def runs(self) -> List[Dict[str, Any]]:
+        """Point-in-time copy of retained runs, most recent first."""
+        with self._lock:
+            return [dict(run) for run in reversed(self._runs.values())]

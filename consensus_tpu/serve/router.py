@@ -252,7 +252,17 @@ class FleetRouter:
         names = [r.name for r in replicas]
         if len(set(names)) != len(names):
             raise ValueError(f"replica names must be unique, got {names}")
+        #: Membership is copy-on-write: every mutation (join/retire) builds
+        #: a fresh list under ``_members_lock`` and swaps the reference, so
+        #: the many lock-free readers (placement, pressure, stats, probe)
+        #: see a consistent snapshot without taking a lock per read.
         self.replicas = list(replicas)
+        self._members_lock = threading.Lock()
+        #: Attached lifecycle layers (set by serve wiring when elastic):
+        #: the ReplicaManager that respawns lost members and the Autoscaler
+        #: driving its target count.  The router closes both at shutdown.
+        self.manager = None
+        self.autoscaler = None
         self.default_timeout_s = default_timeout_s
         self.hedge_after_s = hedge_after_s
         self.probe_interval_s = probe_interval_s
@@ -337,6 +347,12 @@ class FleetRouter:
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         self._draining = True
+        # Lifecycle layers first: a respawn or scale event racing the
+        # drain would re-add members mid-shutdown.
+        if self.autoscaler is not None:
+            self.autoscaler.close()
+        if self.manager is not None:
+            self.manager.close()
         self._stop_probe.set()
         threads = [
             threading.Thread(
@@ -370,6 +386,50 @@ class FleetRouter:
             if replica.name == name:
                 return replica
         raise KeyError(f"no replica named {name!r}")
+
+    # -- elastic membership -------------------------------------------------
+
+    def add_replica(self, replica: Replica) -> None:
+        """Join a (started) replica.  Rendezvous hashing makes the
+        rebalance minimal by construction: only scenario keys the new name
+        wins move to it; every other key keeps its replica and its warm
+        prefix pages.  Re-joining under a RETIRED member's name restores
+        that name's rendezvous mapping exactly — which is why the manager
+        respawns under the corpse's name."""
+        with self._members_lock:
+            if any(r.name == replica.name for r in self.replicas):
+                raise ValueError(
+                    f"replica name {replica.name!r} already in the fleet")
+            if replica.tier not in self.tiers:
+                self.tiers.append(replica.tier)
+                self._lever.n_tiers = len(self.tiers)
+            self.replicas = self.replicas + [replica]
+        with self._counts_lock:
+            self.routed_counts.setdefault(replica.name, 0)
+        self._refresh_gauges()
+
+    def remove_replica(self, name: str) -> Optional[Replica]:
+        """Drop a member from routing (corpse retirement or scale-down).
+        The replica object is returned so the caller can drain/shut it
+        down; its routed_counts history is kept — lifetime accounting
+        outlives membership.  Unknown names are a no-op (the manager and a
+        concurrent shutdown may race)."""
+        removed: Optional[Replica] = None
+        with self._members_lock:
+            keep = []
+            for replica in self.replicas:
+                if replica.name == name and removed is None:
+                    removed = replica
+                else:
+                    keep.append(replica)
+            if removed is not None and keep:
+                self.replicas = keep
+            elif removed is not None:
+                # Never route against an empty list — keep the corpse; its
+                # lost health already excludes it from placement.
+                removed = None
+        self._refresh_gauges()
+        return removed
 
     # -- placement ---------------------------------------------------------
 
@@ -769,4 +829,8 @@ class FleetRouter:
             "routed": routed,
             "replicas": replicas,
         }
+        if self.manager is not None:
+            stats["fleet"]["manager"] = self.manager.snapshot()
+        if self.autoscaler is not None:
+            stats["fleet"]["autoscaler"] = self.autoscaler.snapshot()
         return stats

@@ -157,7 +157,7 @@ class RequestScheduler:
         registry: Optional[Registry] = None,
         brownout: Optional[BrownoutController] = None,
         anytime_margin_s: float = 0.2,
-        engine: bool = False,
+        engine: bool = True,
         engine_options: Optional[Dict[str, Any]] = None,
     ):
         if max_queue_depth < 1 or max_inflight < 1:
@@ -199,9 +199,10 @@ class RequestScheduler:
             flush_ms=flush_ms,
             expected_sessions=self.max_inflight,
             registry=reg,
-            # ``engine=True`` swaps the flush-snapshot merge for the
-            # continuous-batching decode engine — same byte-identical
-            # results, no flush barrier; slot/page pressure joins stats().
+            # The continuous-batching decode engine is the default merge
+            # layer — same byte-identical results as the legacy flush, no
+            # flush barrier; slot/page pressure joins stats().
+            # ``engine=False`` opts back into the flush-snapshot path.
             engine=engine,
             engine_options=engine_options,
         )

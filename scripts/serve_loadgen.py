@@ -29,6 +29,67 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
+def _parse_chaos_schedule(spec):
+    """``'kill:10,kill:25:r1'`` -> ``[(10.0, 'kill', None),
+    (25.0, 'kill', 'r1')]``, sorted by fire time."""
+    events = []
+    for item in str(spec).split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        if len(parts) not in (2, 3) or parts[0] != "kill":
+            raise ValueError(
+                f"chaos event must be 'kill:S' or 'kill:S:NAME', got {item!r}")
+        events.append((float(parts[1]), parts[0],
+                       parts[2] if len(parts) == 3 else None))
+    events.sort(key=lambda e: e[0])
+    return events
+
+
+def _run_chaos(router, schedule, recover_timeout_s, events_out, stop):
+    """Fire ``schedule`` against a live FleetRouter and measure, per kill,
+    how long the fleet takes to read fully healthy again (the elastic
+    manager's detect -> respawn -> warm-seed -> rejoin round trip).  Runs
+    on its own daemon thread alongside the open-loop load."""
+    import time
+
+    start = time.monotonic()
+    for index, (at_s, kind, name) in enumerate(schedule):
+        if stop.wait(max(0.0, start + at_s - time.monotonic())):
+            return
+        target = name
+        if target is None:
+            live = [r.name for r in router.replicas if not r.lost]
+            target = live[0] if live else None
+        event = {"kind": kind, "at_s": at_s, "replica": target,
+                 "recovered_s": None}
+        events_out.append(event)
+        if target is None:
+            continue
+        size_before = len(router.replicas)
+        try:
+            router.kill_replica(target, reason="chaos")
+        except KeyError:
+            continue
+        # Poll until the fleet is back at its pre-kill size with every
+        # member HEALTHY (corpse removal shrinks size mid-recovery, so
+        # healthy == size alone would declare victory too early), bounded
+        # by the recovery timeout and by the next scheduled event.
+        fired = time.monotonic()
+        deadline = fired + recover_timeout_s
+        if index + 1 < len(schedule):
+            deadline = min(deadline, start + schedule[index + 1][0])
+        while time.monotonic() < deadline and not stop.is_set():
+            fleet = router.stats().get("fleet") or {}
+            if (fleet.get("size", 0) >= size_before
+                    and fleet.get("healthy", 0) >= fleet.get("size", 0)):
+                event["recovered_s"] = round(time.monotonic() - fired, 3)
+                break
+            if stop.wait(0.05):
+                return
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--url", default=None,
@@ -64,9 +125,12 @@ def main(argv=None) -> int:
                         help="(self-contained) worker pool size")
     parser.add_argument("--max-queue-depth", type=int, default=64,
                         help="(self-contained) admission queue bound")
-    parser.add_argument("--engine", action="store_true",
+    parser.add_argument("--engine", action=argparse.BooleanOptionalAction,
+                        default=True,
                         help="(self-contained) serve through the "
-                             "continuous-batching decode engine")
+                             "continuous-batching decode engine (the "
+                             "default; --no-engine falls back to the "
+                             "legacy flush-window batcher)")
     parser.add_argument("--prefix-cache", action="store_true",
                         help="(self-contained) enable the engine's "
                              "cross-request prefix KV cache (implies "
@@ -97,13 +161,42 @@ def main(argv=None) -> int:
                              "replica_request_counts and failover_fraction")
     parser.add_argument("--fleet-options", default="{}",
                         help="(self-contained) JSON object of fleet "
-                             "options (tiers, hedge_after_s, ...)")
+                             "options (tiers, hedge_after_s, elastic, "
+                             "autoscale, watchdog_timeout_s, ...)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="(self-contained, fleet) run the replica "
+                             "lifecycle manager: lost replicas respawn "
+                             "under their old name with warm PageStore "
+                             "prefix pages (shorthand for fleet-options "
+                             '{"elastic": true})')
+    parser.add_argument("--autoscale", action="store_true",
+                        help="(self-contained, fleet) run the "
+                             "pressure-driven autoscaler on top of the "
+                             "lifecycle manager (implies --elastic)")
+    parser.add_argument("--watchdog-timeout-s", type=float, default=None,
+                        metavar="S",
+                        help="(self-contained, fleet) arm each replica "
+                             "engine's hang watchdog: a dispatch wedged "
+                             "longer than S marks the replica lost and "
+                             "the elastic ladder respawns it")
+    parser.add_argument("--chaos-schedule", default=None, metavar="EVENTS",
+                        help="(self-contained, fleet) comma-separated "
+                             "fault events, each 'kill:S' or "
+                             "'kill:S:NAME' — kill a replica S seconds "
+                             "into the run (NAME defaults to the first "
+                             "live replica at fire time).  Repeated kills "
+                             "exercise the elastic respawn path; the "
+                             "report gains a 'chaos' block with per-event "
+                             "time-to-recover and the fleet respawn count")
+    parser.add_argument("--chaos-recover-timeout-s", type=float,
+                        default=30.0,
+                        help="cap on the per-event recovery poll (fleet "
+                             "healthy == size) after a chaos kill")
     parser.add_argument("--kill-replica-at-s", type=float, default=None,
                         metavar="S",
-                        help="(self-contained, fleet) kill a replica S "
-                             "seconds into the run: its backend starts "
-                             "raising BackendLostError and in-flight "
-                             "requests fail over")
+                        help="(self-contained, fleet) legacy single-kill "
+                             "form of --chaos-schedule: kill "
+                             "--kill-replica S seconds into the run")
     parser.add_argument("--kill-replica", default="r0", metavar="NAME",
                         help="(self-contained, fleet) which replica "
                              "--kill-replica-at-s kills (default: r0)")
@@ -143,6 +236,14 @@ def main(argv=None) -> int:
         engine_options = json.loads(args.engine_options) or {}
         if args.prefix_cache:
             engine_options.setdefault("prefix_cache", True)
+        fleet_options = json.loads(args.fleet_options) or {}
+        if args.elastic or args.autoscale:
+            fleet_options.setdefault("elastic", True)
+        if args.autoscale:
+            fleet_options.setdefault("autoscale", True)
+        if args.watchdog_timeout_s is not None:
+            fleet_options.setdefault(
+                "watchdog_timeout_s", args.watchdog_timeout_s)
         server = create_server(
             backend="fake",
             port=0,  # ephemeral
@@ -155,34 +256,63 @@ def main(argv=None) -> int:
             or args.mesh is not None,
             engine_options=engine_options or None,
             fleet_size=args.fleet,
-            fleet_options=json.loads(args.fleet_options) or None,
+            fleet_options=fleet_options or None,
             mesh=args.mesh,
         ).start()
-        killer = None
+        schedule = (_parse_chaos_schedule(args.chaos_schedule)
+                    if args.chaos_schedule else [])
         if args.kill_replica_at_s is not None:
+            schedule.append(
+                (args.kill_replica_at_s, "kill", args.kill_replica))
+            schedule.sort(key=lambda e: e[0])
+        chaos_thread = chaos_stop = None
+        chaos_events = []
+        if schedule:
             if args.fleet <= 1:
-                parser.error("--kill-replica-at-s needs --fleet > 1")
+                parser.error("--chaos-schedule / --kill-replica-at-s "
+                             "need --fleet > 1")
             import threading
 
-            killer = threading.Timer(
-                args.kill_replica_at_s,
-                server.scheduler.kill_replica,
-                args=(args.kill_replica,),
+            chaos_stop = threading.Event()
+            chaos_thread = threading.Thread(
+                target=_run_chaos,
+                args=(server.scheduler, schedule,
+                      args.chaos_recover_timeout_s, chaos_events,
+                      chaos_stop),
+                daemon=True,
             )
-            killer.daemon = True
         before = get_registry().snapshot()
         try:
-            if killer is not None:
-                killer.start()
+            if chaos_thread is not None:
+                chaos_thread.start()
             report = run_loadgen(
                 server.base_url, payloads, args.rate,
                 client_timeout_s=args.client_timeout_s,
             )
             report["device_batches"] = server.scheduler.stats()[
                 "device_batches"]
+            if chaos_thread is not None:
+                # Let in-progress recovery polling settle before reading
+                # the event list (bounded; the load has already drained).
+                chaos_thread.join(timeout=args.chaos_recover_timeout_s + 5.0)
+                recovered = [e["recovered_s"] for e in chaos_events
+                             if e["recovered_s"] is not None]
+                manager = (server.scheduler.stats().get("fleet") or {}).get(
+                    "manager") or {}
+                report["chaos"] = {
+                    "events": chaos_events,
+                    "kills": len(chaos_events),
+                    "recovered": len(recovered),
+                    "respawns": manager.get("respawns", 0),
+                    "time_to_recover_s": {
+                        "max": max(recovered) if recovered else None,
+                        "mean": (round(sum(recovered) / len(recovered), 3)
+                                 if recovered else None),
+                    },
+                }
         finally:
-            if killer is not None:
-                killer.cancel()
+            if chaos_stop is not None:
+                chaos_stop.set()
             server.stop()
         delta = diff_snapshots(before, get_registry().snapshot())
 

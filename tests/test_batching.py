@@ -53,7 +53,7 @@ class CountingBackend:
 class TestBatchingBackend:
     def test_concurrent_sessions_share_one_batch(self):
         counting = CountingBackend()
-        batching = BatchingBackend(counting, flush_ms=50.0)
+        batching = BatchingBackend(counting, flush_ms=50.0, engine=False)
         results = {}
         barrier = threading.Barrier(3)
 
@@ -74,7 +74,7 @@ class TestBatchingBackend:
 
     def test_batched_results_match_solo(self):
         counting = CountingBackend()
-        batching = BatchingBackend(counting, flush_ms=20.0)
+        batching = BatchingBackend(counting, flush_ms=20.0, engine=False)
         requests = [
             GenerationRequest(user_prompt=f"prompt {i}", max_tokens=6, seed=i)
             for i in range(3)
@@ -98,7 +98,7 @@ class TestBatchingBackend:
 
     def test_mixed_kinds_flush_independently(self):
         counting = CountingBackend()
-        batching = BatchingBackend(counting, flush_ms=20.0)
+        batching = BatchingBackend(counting, flush_ms=20.0, engine=False)
         out = {}
         barrier = threading.Barrier(2)
 
@@ -129,7 +129,7 @@ class TestBatchingBackend:
 
     def test_embed_slicing(self):
         counting = CountingBackend()
-        batching = BatchingBackend(counting, flush_ms=20.0)
+        batching = BatchingBackend(counting, flush_ms=20.0, engine=False)
         out = {}
         barrier = threading.Barrier(2)
 
@@ -157,7 +157,7 @@ class TestBatchingBackend:
             def generate(self, requests):
                 raise RuntimeError("device on fire")
 
-        batching = BatchingBackend(Exploding(), flush_ms=20.0)
+        batching = BatchingBackend(Exploding(), flush_ms=20.0, engine=False)
         errors = []
         barrier = threading.Barrier(2)
 
@@ -190,8 +190,7 @@ class TestBatchingMetrics:
         registry = Registry()
         counting = CountingBackend()
         batching = BatchingBackend(
-            counting, flush_ms=50.0, expected_sessions=3, registry=registry
-        )
+            counting, flush_ms=50.0, expected_sessions=3, registry=registry, engine=False)
         barrier = threading.Barrier(3)
 
         def worker(tag):
@@ -243,8 +242,7 @@ class TestBatchingMetrics:
         registry = Registry()
         batching = BatchingBackend(
             CountingBackend(), flush_ms=5.0, expected_sessions=4,
-            registry=registry,
-        )
+            registry=registry, engine=False)
         with batching.session():
             batching.score([ScoreRequest(context="ctx", continuation=" more")])
         families = registry.snapshot()["families"]
@@ -353,7 +351,7 @@ class TestFlushSingleFile:
                 return self.inner.embed(texts)
 
         inner = SlowInner()
-        batching = BatchingBackend(inner, flush_ms=5.0, expected_sessions=6)
+        batching = BatchingBackend(inner, flush_ms=5.0, expected_sessions=6, engine=False)
         inner.batching = batching
         done = []
 
@@ -424,8 +422,7 @@ class TestAbortedFlushFailsWaiters:
         # only the all-blocked path (triggered by the generate below) may
         # flush, so both kinds land in one snapshot.
         batching = BatchingBackend(
-            AbortingInner(), flush_ms=30_000.0, expected_sessions=2
-        )
+            AbortingInner(), flush_ms=30_000.0, expected_sessions=2, engine=False)
         score_outcome = {}
 
         def scorer():
@@ -482,8 +479,7 @@ class TestPerKindWakeups:
         registry = Registry()
         inner = SlowGenerate()
         batching = BatchingBackend(
-            inner, flush_ms=500.0, expected_sessions=2, registry=registry
-        )
+            inner, flush_ms=500.0, expected_sessions=2, registry=registry, engine=False)
         out = {}
 
         def gen_worker():
@@ -542,8 +538,7 @@ class TestSessionCancellation:
         registry = Registry()
         counting = CountingBackend()
         batching = BatchingBackend(
-            counting, flush_ms=50.0, expected_sessions=2, registry=registry
-        )
+            counting, flush_ms=50.0, expected_sessions=2, registry=registry, engine=False)
         live_request = GenerationRequest(
             user_prompt="live", max_tokens=4, seed=7)
         barrier = threading.Barrier(2)
@@ -588,7 +583,7 @@ class TestSessionCancellation:
         from consensus_tpu.backends.base import RequestCancelled
 
         counting = CountingBackend()
-        batching = BatchingBackend(counting, flush_ms=5.0)
+        batching = BatchingBackend(counting, flush_ms=5.0, engine=False)
         consults = {"n": 0}
 
         def probe():
@@ -610,7 +605,7 @@ class TestSessionCancellation:
         def bad_probe():
             raise RuntimeError("probe exploded")
 
-        batching = BatchingBackend(CountingBackend(), flush_ms=5.0)
+        batching = BatchingBackend(CountingBackend(), flush_ms=5.0, engine=False)
         with batching.session(cancelled=bad_probe):
             results = batching.generate(
                 [GenerationRequest(user_prompt="x", max_tokens=4, seed=3)]

@@ -198,7 +198,8 @@ class TestPoisonRowIsolation:
         stack = wrap_backend(
             FakeBackend(), fault_plan=plan, registry=registry)
         batching = BatchingBackend(
-            stack, flush_ms=50.0, expected_sessions=3, registry=registry)
+            stack, flush_ms=50.0, expected_sessions=3, registry=registry,
+            engine=False)
 
         reqs = [ScoreRequest(context="ctx", continuation=f"row {i}")
                 for i in range(3)]

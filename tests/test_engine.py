@@ -289,7 +289,7 @@ class TestByteIdentity:
             method, FakeBackend(), dict(params)
         ).generate_statement(ISSUE, OPINIONS)
 
-        legacy = BatchingBackend(FakeBackend(), flush_ms=1.0)
+        legacy = BatchingBackend(FakeBackend(), flush_ms=1.0, engine=False)
         via_legacy = get_method_generator(
             method, legacy, dict(params)
         ).generate_statement(ISSUE, OPINIONS)

@@ -548,6 +548,7 @@ class TestServingAcceptance:
             backend=inner, port=0,
             max_inflight=2, max_queue_depth=6,  # capacity-bounded: 16 > 2+6
             registry=registry, flush_ms=100.0,
+            engine=False,  # pins the legacy flush-coalescing accounting
         ).start()
         payloads = [
             {

@@ -174,7 +174,7 @@ def test_batching_backend_delegates_sessions_to_inner(backend):
     over the batching queue."""
     from consensus_tpu.backends.batching import BatchingBackend
 
-    batching = BatchingBackend(backend)
+    batching = BatchingBackend(backend, engine=False)
     session = open_token_search(batching, make_spec())
     assert isinstance(session, TPUTokenSearchSession)
     assert session.backend is backend
