@@ -68,6 +68,11 @@ from consensus_tpu.obs.metrics import (
     Registry,
     get_registry,
 )
+from consensus_tpu.obs.trace import (
+    IterationLedger,
+    get_flight_recorder,
+    trace_current,
+)
 from consensus_tpu.ops.kv_pages import BlockTable, PagePool, PrefixCache
 
 #: Engine defaults.  ``NUM_PAGES``/``PAGE_SIZE`` give a 16k-token pool —
@@ -88,7 +93,7 @@ class _Item:
 
     __slots__ = (
         "kind", "requests", "probe", "event", "result", "error",
-        "rows_left", "row_results", "row_errors", "failed",
+        "rows_left", "row_results", "row_errors", "failed", "trace", "span",
     )
 
     def __init__(self, kind: str, requests: List[Any], probe):
@@ -104,6 +109,10 @@ class _Item:
         #: Set when the whole item is being failed (cancel/reject): rows
         #: still resident are evicted, rows still queued are dropped.
         self.failed = False
+        #: Request-scoped trace (obs.trace) captured at submit; span 0 (and
+        #: trace None) mean "untraced" and every trace op is a no-op.
+        self.trace = None
+        self.span = 0
 
     def cancelled(self) -> bool:
         if self.probe is None:
@@ -116,7 +125,10 @@ class _Item:
 
 
 class _Row:
-    __slots__ = ("item", "index", "request", "prompt_tokens", "prompt_ids")
+    __slots__ = (
+        "item", "index", "request", "prompt_tokens", "prompt_ids",
+        "trace", "span",
+    )
 
     def __init__(
         self, item: _Item, index: int, request, prompt_ids: List[Any]
@@ -128,6 +140,8 @@ class _Row:
         #: fake one) — page accounting AND the prefix-cache content key.
         self.prompt_ids = prompt_ids
         self.prompt_tokens = max(1, len(prompt_ids))
+        self.trace = None
+        self.span = 0
 
 
 class _Slot:
@@ -328,6 +342,21 @@ class DecodeEngine:
             "Seconds since the decode engine's iteration loop last proved "
             "liveness (sampled by the watchdog monitor thread).",
         )
+        self._m_mfu_device = reg.gauge(
+            "engine_mfu_device_fraction",
+            "Fraction of engine wall time spent inside inner-backend device "
+            "dispatches (iteration-ledger aggregate).",
+        )
+        self._m_mfu_host = reg.gauge(
+            "engine_mfu_host_fraction",
+            "Fraction of engine wall time spent in host-side iteration "
+            "bookkeeping (sweep/admit/prefill/cohort/merge/other) — the "
+            "per-iteration host round-trip loss.",
+        )
+        self._m_mfu_idle = reg.gauge(
+            "engine_mfu_idle_fraction",
+            "Fraction of engine wall time spent idle between iterations.",
+        )
         #: Queued-call cancellations share the batching adapter's counter
         #: family so PR 1 dashboards keep one cancellation series.
         self._cancelled_counter = cancelled_counter
@@ -377,6 +406,16 @@ class DecodeEngine:
         self._occ_iters = 0
         self._search_sessions = 0
         self._search_slots = 0
+        #: Iteration ledger (ROADMAP-3 instrument): per-iteration wall time
+        #: split into host phases / device dispatch / idle, aggregated into
+        #: stats()["mfu_attribution"].  The accumulators below are touched
+        #: only by the iteration thread (or the test thread stepping
+        #: run_iteration) — no lock needed.
+        self.ledger = IterationLedger()
+        self._last_iter_end: Optional[float] = None
+        self._iter_device_s = 0.0
+        self._iter_merge_s = 0.0
+        self._iter_tokens = 0
 
         self._thread: Optional[threading.Thread] = None
         if auto_start:
@@ -400,21 +439,44 @@ class DecodeEngine:
     ):
         """Enqueue one call and block until the loop retires it."""
         item = _Item(kind, list(requests), probe)
+        active = trace_current()
+        if active is not None:
+            trace, parent = active
+            item.trace = trace
+            item.span = trace.begin(
+                f"engine_{kind}", parent=parent, rows=len(item.requests))
         with self._work:
             if self._stopped:
                 raise RuntimeError("decode engine is closed")
             if kind == "generate":
                 for i, req in enumerate(item.requests):
-                    self._gen_backlog.append(
-                        _Row(item, i, req, self._prompt_token_ids(req))
-                    )
+                    row = _Row(item, i, req, self._prompt_token_ids(req))
+                    if item.trace is not None:
+                        row.trace = item.trace
+                        row.span = item.trace.begin(
+                            "engine_row", parent=item.span, row=i)
+                    self._gen_backlog.append(row)
             else:
                 self._other[kind].append(item)
             self._work.notify_all()
         item.event.wait()
+        if item.trace is not None:
+            item.trace.end(
+                item.span,
+                outcome="error" if item.error is not None else "ok")
         if item.error is not None:
             raise item.error
         return item.result
+
+    @staticmethod
+    def _trace_row_event(row: _Row, name: str, **attrs: Any) -> None:
+        if row.trace is not None:
+            row.trace.event(row.span, name, **attrs)
+
+    @staticmethod
+    def _trace_row_end(row: _Row, **attrs: Any) -> None:
+        if row.trace is not None:
+            row.trace.end(row.span, **attrs)
 
     def close(self) -> None:
         with self._work:
@@ -492,6 +554,7 @@ class DecodeEngine:
                 "fused_search_sessions": self._search_sessions,
                 "fused_search_slots": self._search_slots,
                 "backend_lost": self.backend_lost,
+                "mfu_attribution": self.ledger.mfu_attribution(),
                 "watchdog": {
                     "enabled": self.watchdog_timeout_s is not None,
                     "timeout_s": self.watchdog_timeout_s,
@@ -557,11 +620,24 @@ class DecodeEngine:
         """One scheduler iteration.  Public so tests can step the engine
         deterministically (construct with ``auto_start=False``)."""
         self._heartbeat = time.monotonic()
+        t_start = time.perf_counter()
+        idle_s = (
+            max(0.0, t_start - self._last_iter_end)
+            if self._last_iter_end is not None else 0.0
+        )
+        self._iter_device_s = 0.0
+        self._iter_merge_s = 0.0
+        self._iter_tokens = 0
         with self._lock:
+            t0 = time.perf_counter()
             self._process_cancellations()
+            t1 = time.perf_counter()
             self._admit()
+            t2 = time.perf_counter()
             self._advance_prefill()
+            t3 = time.perf_counter()
             cohort = self._decode_cohort()
+            t4 = time.perf_counter()
             occupied = sum(1 for s in self._slots if s is not None)
             occ = occupied / self.n_slots
             self._m_occupancy.set(occ)
@@ -569,6 +645,10 @@ class DecodeEngine:
                 self._occ_sum += occ
                 self._occ_iters += 1
             self.iterations += 1
+            queue_depth = len(self._gen_backlog) + sum(
+                len(q) for q in self._other.values()
+            )
+            pages_in_use = sum(pool.in_use for pool in self.pools)
             others = {
                 kind: queue[:] for kind, queue in self._other.items() if queue
             }
@@ -592,6 +672,30 @@ class DecodeEngine:
         finally:
             self._busy_since = None
             self._heartbeat = time.monotonic()
+            t_end = time.perf_counter()
+            row = self.ledger.record(
+                start_s=t_start,
+                end_s=t_end,
+                idle_s=idle_s,
+                device_s=self._iter_device_s,
+                host={
+                    "sweep": t1 - t0,
+                    "admit": t2 - t1,
+                    "prefill": t3 - t2,
+                    "cohort": t4 - t3,
+                    "merge": self._iter_merge_s,
+                },
+                tokens=self._iter_tokens,
+                cohort=len(cohort),
+                queue_depth=queue_depth,
+                pages_in_use=pages_in_use,
+            )
+            self._last_iter_end = t_end
+            get_flight_recorder().record_iteration(row)
+            mfu = self.ledger.mfu_attribution()
+            self._m_mfu_device.set(mfu["device_fraction"])
+            self._m_mfu_host.set(mfu["host_fraction"])
+            self._m_mfu_idle.set(mfu["idle_fraction"])
 
     def _watchdog_loop(self) -> None:
         """Monitor thread: trip when a dispatched inner call has made no
@@ -612,6 +716,14 @@ class DecodeEngine:
                 self.backend_lost = True
                 self.watchdog_trips += 1
                 self._m_watchdog_trips.inc()
+                recorder = get_flight_recorder()
+                recorder.record_event(
+                    "watchdog_trip",
+                    timeout_s=self.watchdog_timeout_s,
+                    busy_s=round(now - busy, 3),
+                    iterations=self.iterations,
+                )
+                recorder.dump("watchdog_trip")
 
     # -- iteration phases (lock held) ---------------------------------------
 
@@ -721,6 +833,11 @@ class DecodeEngine:
             self._reserved[shard] += slot.reserved
             occupied[shard] += 1
             self._m_admitted.inc()
+            self._trace_row_event(
+                row, "slot_admitted", slot=slot.idx, shard=shard,
+                cached_tokens=cached_tokens)
+            if slot.state == _READY:
+                self._trace_row_event(row, "prefill_complete", cached=True)
 
     def _advance_prefill(self) -> None:
         for slot in self._slots:
@@ -734,8 +851,10 @@ class DecodeEngine:
                 slot.prefilled += chunk
                 self._m_prefill_chunks.inc()
                 self._m_prefill_tokens.inc(chunk)
+                self._trace_row_event(slot.row, "prefill_chunk", tokens=chunk)
             if slot.prefilled >= slot.row.prompt_tokens:
                 slot.state = _READY
+                self._trace_row_event(slot.row, "prefill_complete")
 
     def _decode_cohort(self) -> List[_Slot]:
         ready = [s for s in self._slots if s is not None and s.state == _READY]
@@ -759,9 +878,13 @@ class DecodeEngine:
     def _dispatch_decode(self, cohort: List[_Slot]) -> None:
         requests = [slot.row.request for slot in cohort]
         self.dispatch_counts["generate"] += 1
+        for slot in cohort:
+            self._trace_row_event(
+                slot.row, "decode_dispatch", cohort=len(cohort))
         results: Optional[List[Any]] = None
         row_errors: Dict[int, BaseException] = {}
         batch_error: Optional[BaseException] = None
+        t_dev = time.perf_counter()
         try:
             results = self.inner.generate(requests)
         except PartialBatchError as exc:
@@ -771,25 +894,34 @@ class DecodeEngine:
             batch_error = exc
             if isinstance(exc, BackendLostError):
                 self.backend_lost = True
+        self._iter_device_s += time.perf_counter() - t_dev
 
+        t_merge = time.perf_counter()
         with self._lock:
             tokens = 0
             for i, slot in enumerate(cohort):
                 self._retire(slot)
                 item = slot.row.item
                 if batch_error is not None:
+                    self._trace_row_end(slot.row, outcome="error")
                     self._fail_item(item, batch_error)
                 elif i in row_errors:
+                    self._trace_row_end(slot.row, outcome="error")
                     self._record_row(item, slot.row.index, None, row_errors[i])
                 else:
                     result = results[i]
                     ids = getattr(result, "token_ids", None) or ()
-                    tokens += len(ids) if ids else self._count_text_tokens(
+                    row_tokens = len(ids) if ids else self._count_text_tokens(
                         getattr(result, "text", "") or ""
                     )
+                    tokens += row_tokens
+                    self._trace_row_end(
+                        slot.row, outcome="retired", tokens=row_tokens)
                     self._record_row(item, slot.row.index, result, None)
+            self._iter_tokens += tokens
             self._m_tokens_iter.observe(tokens)
             self._work.notify_all()
+        self._iter_merge_s += time.perf_counter() - t_merge
 
     def _dispatch_other(self, kind: str, items: List[_Item]) -> None:
         fn = {
@@ -817,8 +949,17 @@ class DecodeEngine:
         if kind == "score_matrix":
             reserved = self._reserve_matrix_pages(merged)
         self.dispatch_counts[kind] += 1
+        for item in items:
+            if item.trace is not None:
+                item.trace.event(
+                    item.span, "engine_dispatch", kind=kind,
+                    batch=len(dispatch))
         try:
-            results = fn(dispatch)
+            t_dev = time.perf_counter()
+            try:
+                results = fn(dispatch)
+            finally:
+                self._iter_device_s += time.perf_counter() - t_dev
             if mapping is not None:
                 from consensus_tpu.backends.score_matrix import expand_deduped
 
@@ -931,6 +1072,7 @@ class DecodeEngine:
 
     def _evict(self, slot: _Slot, count: bool = True) -> None:
         self._retire(slot)
+        self._trace_row_end(slot.row, outcome="evicted")
         if count:
             self._m_evicted.inc()
 

@@ -111,6 +111,7 @@ class CircuitBreaker:
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
+        self._name = name
         self._lock = threading.Lock()
         self._state = "closed"
         self._consecutive_failures = 0
@@ -147,6 +148,14 @@ class CircuitBreaker:
         return self._state
 
     def _to(self, state: str) -> None:
+        if state == "open" and self._state != "open":
+            # Flight-recorder breadcrumb: breaker opens are exactly the
+            # fleet events a postmortem wants next to the iteration rows.
+            from consensus_tpu.obs.trace import get_flight_recorder
+
+            get_flight_recorder().record_event(
+                "breaker_open", breaker=self._name,
+                consecutive_failures=self._consecutive_failures)
         self._state = state
         self._m_state.set(_STATE_VALUES[state])
 

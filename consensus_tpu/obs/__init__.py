@@ -39,19 +39,39 @@ from consensus_tpu.obs.metrics import (
     merge_snapshots,
 )
 from consensus_tpu.obs.spans import SpanTracer, diff_span_paths, get_span_tracer
+from consensus_tpu.obs.trace import (
+    FlightRecorder,
+    IterationLedger,
+    RollingWindow,
+    TraceContext,
+    TraceStore,
+    get_flight_recorder,
+    get_trace_store,
+    trace_current,
+    use_trace,
+)
 
 __all__ = [
     "BackendInstruments",
     "DEFAULT_COUNT_BUCKETS",
     "DEFAULT_TIME_BUCKETS",
+    "FlightRecorder",
+    "IterationLedger",
     "Registry",
+    "RollingWindow",
     "SpanTracer",
+    "TraceContext",
+    "TraceStore",
     "bucket_recompiles",
     "diff_snapshots",
     "diff_span_paths",
     "exponential_buckets",
+    "get_flight_recorder",
     "get_registry",
     "get_span_tracer",
+    "get_trace_store",
     "merge_snapshots",
     "padding_efficiency",
+    "trace_current",
+    "use_trace",
 ]

@@ -105,6 +105,11 @@ def main(argv=None) -> int:
                              "shard TPU backend params Megatron-style over "
                              "tp and partition the decode engine's slots + "
                              "page pools over dp (e.g. --mesh dp=4,tp=2)")
+    parser.add_argument("--blackbox", default=None, metavar="PATH",
+                        help="write the flight recorder's blackbox JSON "
+                             "(recent iterations + fleet events) to PATH on "
+                             "watchdog trip, replica loss, or SIGTERM "
+                             "(env: CONSENSUS_BLACKBOX)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -113,7 +118,11 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
 
+    from consensus_tpu.obs.trace import get_flight_recorder
     from consensus_tpu.serve import create_server
+
+    if args.blackbox:
+        get_flight_recorder().configure(args.blackbox)
 
     fleet_options = json.loads(args.fleet_options) or {}
     if args.elastic or args.autoscale:
@@ -147,6 +156,8 @@ def main(argv=None) -> int:
     def handle_signal(signum, frame):
         logging.getLogger("consensus_tpu.serve").info(
             "signal %d: draining and shutting down", signum)
+        get_flight_recorder().dump(
+            "sigterm" if signum == signal.SIGTERM else "sigint")
         stop.set()
 
     signal.signal(signal.SIGINT, handle_signal)
@@ -155,7 +166,8 @@ def main(argv=None) -> int:
     server.start()
     print(json.dumps({
         "serving": server.base_url,
-        "endpoints": ["POST /v1/consensus", "GET /healthz", "GET /metrics"],
+        "endpoints": ["POST /v1/consensus", "GET /healthz", "GET /metrics",
+                      "GET /v1/trace/<request_id>"],
         "backend": args.backend,
         "max_queue_depth": args.max_queue_depth,
         "max_inflight": args.max_inflight,

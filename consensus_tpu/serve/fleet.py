@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from consensus_tpu.backends.base import Backend, BackendLostError
 from consensus_tpu.obs.metrics import Registry, get_registry
+from consensus_tpu.obs.trace import get_flight_recorder
 from consensus_tpu.serve.brownout import BrownoutController
 from consensus_tpu.serve.scheduler import RequestScheduler
 from consensus_tpu.serve.service import ConsensusService
@@ -165,6 +166,8 @@ class Replica:
             brownout=brownout,
             **(scheduler_options or {}),
         )
+        # Spans and per-replica health report which replica served.
+        self.scheduler.replica_name = name
         self._lost = threading.Event()
         self._lost_reason = ""
 
@@ -192,6 +195,10 @@ class Replica:
         if not self._lost.is_set():
             self._lost_reason = reason
             self._lost.set()
+            recorder = get_flight_recorder()
+            recorder.record_event(
+                "replica_lost", replica=self.name, reason=reason)
+            recorder.dump("replica_loss")
 
     # -- health -------------------------------------------------------------
 
@@ -424,6 +431,8 @@ class ReplicaManager:
         with self._lock:
             self.target = max(1, int(n))
             self._m_target.set(self.target)
+            get_flight_recorder().record_event(
+                "scale_target", target=self.target)
             return self.target
 
     def clear_quarantine(self, name: str) -> bool:
@@ -488,6 +497,10 @@ class ReplicaManager:
                     )
                     self._pending.pop(replica.name, None)
                     self._m_quarantined.inc()
+                    get_flight_recorder().record_event(
+                        "quarantine", replica=replica.name,
+                        losses=len(history),
+                        window_s=self.flap_window_s)
                     continue
                 backoff = self._backoffs.get(
                     replica.name, self.respawn_backoff_s
@@ -545,6 +558,8 @@ class ReplicaManager:
                 victim = victims.pop()
                 removed = self.router.remove_replica(victim.name)
                 if removed is not None:
+                    get_flight_recorder().record_event(
+                        "scale_down", replica=removed.name)
                     self._retire_async(removed, drain=True)
 
     # -- spawn / retire -----------------------------------------------------
@@ -561,6 +576,8 @@ class ReplicaManager:
                 except Exception:
                     pass  # cold join is a degraded start, not a failure
         self.router.add_replica(replica)
+        get_flight_recorder().record_event(
+            "respawn" if respawn else "scale_up", replica=name)
         if respawn:
             with self._lock:
                 self.respawns += 1

@@ -26,13 +26,18 @@ an admitted ticket costs one parked thread, nothing on device.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import itertools
 import json
 import logging
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 from consensus_tpu.obs.metrics import Registry, get_registry
+from consensus_tpu.obs.trace import TraceContext, get_trace_store, use_trace
 from consensus_tpu.serve.scheduler import (
     RequestScheduler,
     RequestTimeout,
@@ -57,6 +62,23 @@ _UNBOUNDED_WAIT_S = 3600.0
 #: Retry-After hint on 504s: the deadline was the client's own budget, so
 #: there is no server cooldown to report — suggest a short backoff.
 _TIMEOUT_RETRY_AFTER_S = 1
+
+#: Server-minted request ids: a process-local sequence for uniqueness plus
+#: a payload digest for determinism, so the same omitted-id request body
+#: always maps to the same digest suffix and every response — success or
+#: error — is trace-addressable.
+_MINT_SEQ = itertools.count(1)
+
+
+def _mint_request_id(payload: Any) -> str:
+    try:
+        canonical = json.dumps(payload, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        canonical = repr(payload)
+    digest = hashlib.blake2b(
+        canonical.encode("utf-8"), digest_size=4
+    ).hexdigest()
+    return f"srv-{next(_MINT_SEQ):06d}-{digest}"
 
 
 class ConsensusHTTPServer(ThreadingHTTPServer):
@@ -87,6 +109,17 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             body = self.server.registry.to_prometheus().encode("utf-8")
             self._send_bytes(200, body, "text/plain; version=0.0.4")
+        elif self.path.startswith("/v1/trace/"):
+            trace_id = urllib.parse.unquote(self.path[len("/v1/trace/"):])
+            trace = get_trace_store().get(trace_id)
+            if trace is None:
+                self._send_error_json(
+                    404, "trace_not_found",
+                    f"no trace retained for request id {trace_id!r}")
+            else:
+                payload = trace.to_dict()
+                payload["critical_path"] = trace.critical_path()
+                self._send_json(200, payload)
         else:
             self._send_error_json(404, "not_found",
                                   f"no route for GET {self.path}")
@@ -110,59 +143,95 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
                 "details": exc.errors,
             }})
             return
+        if not request.request_id:
+            # Server-side mint: every response (success or error) carries a
+            # request id, so every request is trace-addressable.
+            request = dataclasses.replace(
+                request, request_id=_mint_request_id(payload))
+        request_id = request.request_id
+        trace = TraceContext(request_id)
+        root = trace.begin(
+            "http_request", method=request.method, path=self.path,
+            request_id=request_id)
+        get_trace_store().put(trace)
         scheduler = self.server.scheduler
+        status = 500
         try:
-            ticket = scheduler.submit(request)
-        except SchedulerRejected as exc:
-            self._send_rejection(exc)
-            return
-        remaining = ticket.remaining()
-        wait_s = (
-            remaining + _WAIT_GRACE_S if remaining is not None
-            else _UNBOUNDED_WAIT_S
-        )
-        if not ticket.wait(timeout=max(0.0, wait_s)):
-            # Cooperative cancellation: a queued ticket dies at pop; a
-            # running one sees the expired BudgetClock (or the dropped batch
-            # entry) at its next checkpoint and returns its best-so-far
-            # statement tagged ``degraded`` — so linger briefly for that
-            # partial before conceding a 504.  Anytime over unavailable.
-            ticket.cancel()
-            if not ticket.wait(timeout=_DEGRADED_GRACE_S):
-                self._send_error_json(
-                    504, "timeout",
-                    "deadline expired before any search wave completed",
-                    headers={"Retry-After": str(_TIMEOUT_RETRY_AFTER_S)})
+            try:
+                with use_trace(trace, root):
+                    ticket = scheduler.submit(request)
+            except SchedulerRejected as exc:
+                status = self._send_rejection(exc, request_id=request_id)
                 return
-        try:
-            result = ticket.result()
-        except RequestTimeout as exc:
-            self._send_error_json(
-                504, "timeout", str(exc),
-                headers={"Retry-After": str(_TIMEOUT_RETRY_AFTER_S)})
-            return
-        except SchedulerRejected as exc:
-            self._send_rejection(exc)
-            return
-        except Exception as exc:
-            self._send_json(500, {"error": {
-                "type": "backend_failure",
-                "exception": type(exc).__name__,
-                "message": str(exc),
-                "attempts": ticket.attempts,
-            }})
-            return
-        self._send_json(200, result)
+            remaining = ticket.remaining()
+            wait_s = (
+                remaining + _WAIT_GRACE_S if remaining is not None
+                else _UNBOUNDED_WAIT_S
+            )
+            if not ticket.wait(timeout=max(0.0, wait_s)):
+                # Cooperative cancellation: a queued ticket dies at pop; a
+                # running one sees the expired BudgetClock (or the dropped
+                # batch entry) at its next checkpoint and returns its
+                # best-so-far statement tagged ``degraded`` — so linger
+                # briefly for that partial before conceding a 504.  Anytime
+                # over unavailable.
+                ticket.cancel()
+                if not ticket.wait(timeout=_DEGRADED_GRACE_S):
+                    status = 504
+                    self._send_error_json(
+                        504, "timeout",
+                        "deadline expired before any search wave completed",
+                        headers={"Retry-After": str(_TIMEOUT_RETRY_AFTER_S)},
+                        request_id=request_id)
+                    return
+            try:
+                result = ticket.result()
+            except RequestTimeout as exc:
+                status = 504
+                self._send_error_json(
+                    504, "timeout", str(exc),
+                    headers={"Retry-After": str(_TIMEOUT_RETRY_AFTER_S)},
+                    request_id=request_id)
+                return
+            except SchedulerRejected as exc:
+                status = self._send_rejection(exc, request_id=request_id)
+                return
+            except Exception as exc:
+                status = 500
+                self._send_json(500, {"error": {
+                    "type": "backend_failure",
+                    "exception": type(exc).__name__,
+                    "message": str(exc),
+                    "attempts": ticket.attempts,
+                    "request_id": request_id,
+                }})
+                return
+            status = 200
+            # End the root BEFORE snapshotting so the debug block's
+            # critical path covers the full served latency.
+            trace.end(root, status=200)
+            if request.trace:
+                result = dict(result)
+                result["trace"] = {
+                    "trace_id": trace.trace_id,
+                    "critical_path": trace.critical_path(),
+                    "spans": trace.to_dict()["spans"],
+                }
+            self._send_json(200, result)
+        finally:
+            trace.end(root, status=status)
 
     # -- helpers -----------------------------------------------------------
 
-    def _send_rejection(self, exc: SchedulerRejected) -> None:
+    def _send_rejection(self, exc: SchedulerRejected,
+                        request_id: Optional[str] = None) -> int:
         """Admission rejections: 503 for an open circuit breaker (the
         backend is down — clients should back off for its cooldown), 413
         for a request whose KV footprint exceeds the engine's page pool
         (the REQUEST is too large — retrying unchanged can never succeed,
         so no Retry-After), 429 for overload (queue_full/draining — retry
-        soon elsewhere)."""
+        soon elsewhere).  Returns the status sent so the caller can stamp
+        it on the trace root."""
         if exc.reason == "breaker_open":
             status = 503
         elif exc.reason == "kv_oom":
@@ -175,11 +244,15 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
                 exc.retry_after_s if exc.retry_after_s is not None else 1
             )
             headers = {"Retry-After": str(int(max(1, retry_after)))}
-        self._send_json(status, {"error": {
+        error: Dict[str, Any] = {
             "type": "rejected",
             "reason": exc.reason,
             "message": str(exc),
-        }}, headers=headers)
+        }
+        if request_id:
+            error["request_id"] = request_id
+        self._send_json(status, {"error": error}, headers=headers)
+        return status
 
     def _health_payload(self) -> Dict[str, Any]:
         scheduler = self.server.scheduler
@@ -220,10 +293,12 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         self._send_bytes(status, body, "application/json", headers)
 
     def _send_error_json(self, status: int, error_type: str, message: str,
-                         headers: Optional[Dict[str, str]] = None) -> None:
-        self._send_json(status, {"error": {"type": error_type,
-                                           "message": message}},
-                        headers=headers)
+                         headers: Optional[Dict[str, str]] = None,
+                         request_id: Optional[str] = None) -> None:
+        error: Dict[str, Any] = {"type": error_type, "message": message}
+        if request_id:
+            error["request_id"] = request_id
+        self._send_json(status, {"error": error}, headers=headers)
 
     def _send_bytes(self, status: int, body: bytes, content_type: str,
                     headers: Optional[Dict[str, str]] = None) -> None:

@@ -29,6 +29,7 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 from consensus_tpu.data.aamas_scenarios import SCENARIOS
+from consensus_tpu.obs.trace import RollingWindow
 
 
 def _scenario_sequence(
@@ -140,6 +141,9 @@ class RequestOutcome:
     #: Fleet mode: which replica / model tier served the 200 ("" otherwise).
     served_by: str = ""
     served_tier: str = ""
+    #: Launch offset from the run's start (seconds) — lets the report
+    #: bucket outcomes into a recovery curve without re-deriving arrivals.
+    started_s: float = 0.0
 
 
 def _percentile(sorted_values: List[float], q: float) -> float:
@@ -157,6 +161,7 @@ def run_loadgen(
     payloads: List[Dict[str, Any]],
     rate_rps: float,
     client_timeout_s: float = 60.0,
+    curve_bucket_s: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Replay ``payloads`` open-loop at ``rate_rps`` against ``base_url``.
 
@@ -176,6 +181,7 @@ def run_loadgen(
             method="POST",
         )
         start = time.perf_counter()
+        started_s = max(0.0, start - start_wall)
         try:
             with urllib.request.urlopen(
                 request, timeout=client_timeout_s
@@ -189,6 +195,7 @@ def run_loadgen(
                     degraded=bool(data.get("degraded", False)),
                     served_by=str(data.get("served_by", "")),
                     served_tier=str(data.get("served_tier", "")),
+                    started_s=started_s,
                 )
         except urllib.error.HTTPError as exc:
             try:
@@ -200,6 +207,7 @@ def run_loadgen(
                 status=exc.code,
                 latency_s=time.perf_counter() - start,
                 error_type=error.get("type", "http_error"),
+                started_s=started_s,
             )
         except Exception as exc:
             outcomes[index] = RequestOutcome(
@@ -207,6 +215,7 @@ def run_loadgen(
                 status=0,
                 latency_s=time.perf_counter() - start,
                 error_type=type(exc).__name__,
+                started_s=started_s,
             )
 
     fleet_before = fetch_fleet_stats(base_url)
@@ -271,6 +280,19 @@ def run_loadgen(
         },
         "outcomes": done,
     }
+    # Recovery curve: time-bucketed availability/rps/p95 over the run, so
+    # chaos and elastic runs can show the dip at the fault and the climb
+    # back after respawn instead of one blended availability number.
+    bucket_s = curve_bucket_s or max(0.5, round(wall_s / 12.0, 1) or 0.5)
+    window = RollingWindow(bucket_s=bucket_s)
+    for outcome in done:
+        is_ok = outcome.status == 200
+        window.observe(
+            outcome.started_s, ok=is_ok,
+            latency_s=outcome.latency_s if is_ok else None,
+        )
+    report["recovery_bucket_s"] = bucket_s
+    report["recovery_curve"] = window.curve()
     tier_counts = fetch_tier_counts(base_url)
     if tier_counts is not None:
         report["tier_request_counts"] = tier_counts

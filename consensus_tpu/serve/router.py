@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from consensus_tpu.backends.base import BackendLostError
 from consensus_tpu.obs.metrics import Registry, get_registry
+from consensus_tpu.obs.trace import trace_current, use_trace
 from consensus_tpu.serve.fleet import DEGRADED, HEALTHY, Replica
 from consensus_tpu.serve.scheduler import (
     RequestTimeout,
@@ -158,6 +159,13 @@ class FleetTicket:
         self._last_error: Optional[BaseException] = None
         self._done = threading.Event()
         self._cancelled = threading.Event()
+        #: Request-scoped trace carrier: captured once at fleet submit;
+        #: every dispatch (primary / failover / hedge) opens a "dispatch"
+        #: span keyed here by the inner ticket's identity so the waiter
+        #: loop can close it with the dispatch's fate.
+        self.trace = None
+        self._span_parent: Optional[int] = None
+        self._span_by_ticket: Dict[int, int] = {}
 
     # -- waiter surface ----------------------------------------------------
 
@@ -209,12 +217,22 @@ class FleetTicket:
 
     # -- router side -------------------------------------------------------
 
-    def _attach(self, ticket: Ticket, replica: Replica) -> None:
+    def _attach(self, ticket: Ticket, replica: Replica,
+                span: int = 0) -> None:
         with self._lock:
             self._pairs.append((ticket, replica))
             self._needs_dispatch = None
+        if span:
+            self._span_by_ticket[id(ticket)] = span
         self.dispatches += 1
         self.tried.add(replica.name)
+
+    def _end_dispatch_span(self, inner: Ticket, **attrs: Any) -> None:
+        """Close the dispatch span opened for ``inner`` (no-op untraced)."""
+        if self.trace is None:
+            return
+        span = self._span_by_ticket.pop(id(inner), 0)
+        self.trace.end(span, **attrs)
 
     def _resolve(self, outcome: str, value: Any = None,
                  error: Optional[BaseException] = None) -> None:
@@ -228,6 +246,9 @@ class FleetTicket:
         for ticket, _ in pairs:
             if not ticket.done():
                 ticket.cancel()
+            # A hedge loser (or an attempt obsoleted by resolution) closes
+            # as cancelled; the winner's span was already closed final.
+            self._end_dispatch_span(ticket, outcome="cancelled")
         self._done.set()
 
 
@@ -516,6 +537,9 @@ class FleetRouter:
             else None
         )
         ticket = FleetTicket(self, request, deadline)
+        active = trace_current()
+        if active is not None:
+            ticket.trace, ticket._span_parent = active
         tier = self._serving_tier()
         candidates = self._candidates(_scenario_key(request), tier)
         if not candidates:
@@ -523,18 +547,35 @@ class FleetRouter:
                 "no_replica", "no routable replica in the fleet")
         last: Optional[SchedulerRejected] = None
         for replica in candidates:
+            span = self._begin_dispatch_span(ticket, replica, "primary")
             try:
-                inner = replica.scheduler.submit(
-                    request, timeout_s=ticket.remaining())
+                with use_trace(ticket.trace, span):
+                    inner = replica.scheduler.submit(
+                        request, timeout_s=ticket.remaining())
             except SchedulerRejected as exc:
+                if ticket.trace is not None:
+                    ticket.trace.end(span, outcome="rejected",
+                                     rejected_reason=exc.reason)
                 last = exc
                 continue
-            ticket._attach(inner, replica)
+            ticket._attach(inner, replica, span)
             self._count_routed(replica, affinity_hit=replica is candidates[0])
             self._refresh_gauges()
             return ticket
         assert last is not None
         raise last
+
+    @staticmethod
+    def _begin_dispatch_span(ticket: FleetTicket, replica: Replica,
+                             reason: str) -> int:
+        """Open a "dispatch" span: one per inner submission, tagged with
+        the replica, its tier, and WHY this dispatch happened (primary /
+        failover reason / hedge)."""
+        if ticket.trace is None:
+            return 0
+        return ticket.trace.begin(
+            "dispatch", parent=ticket._span_parent,
+            replica=replica.name, tier=replica.tier, reason=reason)
 
     # -- waiter-driven progression -----------------------------------------
 
@@ -599,6 +640,8 @@ class FleetRouter:
                 self._resolve_value(ticket, inner, replica)
                 return
             if inner.outcome == "timeout":
+                ticket._end_dispatch_span(inner, outcome="timeout",
+                                          final=True)
                 try:
                     inner.result()
                 except BaseException as exc:  # noqa: BLE001
@@ -612,6 +655,8 @@ class FleetRouter:
                 error = exc
             reason = self._failover_reason(error)
             if reason is None or ticket.cancelled:
+                ticket._end_dispatch_span(inner, outcome="failed",
+                                          final=True)
                 ticket._resolve("failed", error=error)
                 return
             if isinstance(error, BackendLostError):
@@ -635,6 +680,7 @@ class FleetRouter:
                    error: Optional[BaseException] = None) -> None:
         """Remove a dead dispatch; if it was the last one, enter the
         failover re-queue state (and count the failover)."""
+        ticket._end_dispatch_span(inner, outcome="dropped", dropped=reason)
         with ticket._lock:
             ticket._pairs = [p for p in ticket._pairs if p[0] is not inner]
             survivors = len(ticket._pairs)
@@ -659,6 +705,8 @@ class FleetRouter:
             return True
         tier = self._serving_tier()
         key = _scenario_key(ticket.request)
+        with ticket._lock:
+            redispatch_reason = ticket._needs_dispatch or "failover"
         # Prefer replicas this request has not yet died on; fall back to
         # any routable one (a retried replica may have recovered workers).
         candidates = (
@@ -673,12 +721,18 @@ class FleetRouter:
                 return True
             return False  # replicas exist but are busy/draining: retry
         for replica in candidates:
+            span = self._begin_dispatch_span(ticket, replica,
+                                             redispatch_reason)
             try:
-                inner = replica.scheduler.submit(
-                    ticket.request, timeout_s=ticket.remaining())
-            except SchedulerRejected:
+                with use_trace(ticket.trace, span):
+                    inner = replica.scheduler.submit(
+                        ticket.request, timeout_s=ticket.remaining())
+            except SchedulerRejected as exc:
+                if ticket.trace is not None:
+                    ticket.trace.end(span, outcome="rejected",
+                                     rejected_reason=exc.reason)
                 continue
-            ticket._attach(inner, replica)
+            ticket._attach(inner, replica, span)
             self._count_routed(replica)
             return True
         return False
@@ -692,12 +746,17 @@ class FleetRouter:
             if r.name != serving.name and r.health == HEALTHY
         ]
         for replica in candidates:
+            span = self._begin_dispatch_span(ticket, replica, "hedge")
             try:
-                inner = replica.scheduler.submit(
-                    ticket.request, timeout_s=ticket.remaining())
-            except SchedulerRejected:
+                with use_trace(ticket.trace, span):
+                    inner = replica.scheduler.submit(
+                        ticket.request, timeout_s=ticket.remaining())
+            except SchedulerRejected as exc:
+                if ticket.trace is not None:
+                    ticket.trace.end(span, outcome="rejected",
+                                     rejected_reason=exc.reason)
                 continue
-            ticket._attach(inner, replica)
+            ticket._attach(inner, replica, span)
             self._count_routed(replica)
             with self._counts_lock:
                 self.hedges_total += 1
@@ -711,6 +770,7 @@ class FleetRouter:
         below the default tier."""
         value = inner.result()
         outcome = inner.outcome or "ok"
+        ticket._end_dispatch_span(inner, outcome=outcome, final=True)
         if isinstance(value, dict):
             value["served_by"] = replica.name
             value["served_tier"] = replica.tier

@@ -48,6 +48,7 @@ from consensus_tpu.backends.base import Backend, TransientBackendError
 from consensus_tpu.backends.batching import BatchingBackend
 from consensus_tpu.methods.anytime import BudgetClock, BudgetExpired
 from consensus_tpu.obs.metrics import Registry, get_registry
+from consensus_tpu.obs.trace import trace_current, use_trace
 from consensus_tpu.serve.brownout import BrownoutController
 
 logger = logging.getLogger(__name__)
@@ -95,6 +96,13 @@ class Ticket:
         self._error: Optional[BaseException] = None
         self._done = threading.Event()
         self._cancelled = threading.Event()
+        #: Request-scoped trace carrier (obs.trace), captured at submit from
+        #: the submitting thread's active context; span ids are 0 (= no-op)
+        #: when tracing is not active for this request.
+        self.trace = None
+        self._span_parent: Optional[int] = None
+        self._span_queue = 0
+        self._span_handler = 0
 
     # -- waiter side -------------------------------------------------------
 
@@ -240,6 +248,10 @@ class RequestScheduler:
             "Requests resolved with a degraded (anytime partial or "
             "budget-scaled) statement instead of a timeout/full result.")
 
+        #: Stamped by the fleet's Replica wrapper so spans and health report
+        #: which replica served; empty for a standalone scheduler.
+        self.replica_name = ""
+
         self._lock = threading.Lock()
         self._work_cv = threading.Condition(self._lock)
         self._idle_cv = threading.Condition(self._lock)
@@ -315,6 +327,9 @@ class RequestScheduler:
             else None
         )
         ticket = Ticket(request, deadline)
+        active = trace_current()
+        if active is not None:
+            ticket.trace, ticket._span_parent = active
         with self._lock:
             if self._stopped or self._draining:
                 self._m_rejected.labels("draining").inc()
@@ -333,6 +348,12 @@ class RequestScheduler:
                     "queue_full",
                     f"admission queue is full "
                     f"({self.max_queue_depth} waiting); retry later")
+            if ticket.trace is not None:
+                # Begun before the worker can pop the ticket, so queue_wait
+                # covers the full admission->dispatch interval.
+                ticket._span_queue = ticket.trace.begin(
+                    "queue_wait", parent=ticket._span_parent,
+                    replica=self.replica_name)
             self._queue.append(ticket)
             self._m_accepted.inc()
             self._m_queue_depth.set(len(self._queue))
@@ -444,6 +465,12 @@ class RequestScheduler:
 
     def _run_ticket(self, ticket: Ticket) -> None:
         method = getattr(ticket.request, "method", "unknown")
+        trace = ticket.trace
+        if trace is not None:
+            trace.end(ticket._span_queue)
+            ticket._span_handler = trace.begin(
+                "handler", parent=ticket._span_parent,
+                replica=self.replica_name, method=method)
         self._update_brownout()
         if ticket.cancelled or ticket.expired():
             # Died in the queue: the cheap overload outcome — no device
@@ -464,7 +491,12 @@ class RequestScheduler:
                 # layer: queued device calls of an abandoned ticket are
                 # dropped at the flush snapshot (RequestCancelled) instead
                 # of spending device time co-batched with live requests.
-                with self.batching.session(cancelled=lambda: ticket.cancelled):
+                # The trace context is re-established on THIS worker thread
+                # so the engine's submit() (called from inside the handler)
+                # can parent its spans under the handler span.
+                with self.batching.session(
+                    cancelled=lambda: ticket.cancelled
+                ), use_trace(trace, ticket._span_handler):
                     value = self.handler(
                         ticket.request, self.batching, **handler_kwargs
                     )
@@ -553,4 +585,8 @@ class RequestScheduler:
             # Timeouts feed the tracker too: they ARE the latency tail the
             # controller exists to shave.
             self.brownout.record_latency(elapsed)
+        if ticket.trace is not None:
+            ticket.trace.end(ticket._span_queue)
+            ticket.trace.end(ticket._span_handler, outcome=outcome,
+                             attempts=ticket.attempts)
         ticket._finish(outcome, value=value, error=error)

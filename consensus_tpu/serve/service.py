@@ -70,6 +70,8 @@ class ConsensusRequest:
     #: Client-requested deadline in seconds (None → server default).
     timeout_s: Optional[float] = None
     request_id: str = ""
+    #: Attach the span tree + critical-path debug block to the response.
+    trace: bool = False
 
 
 def parse_request(payload: Any) -> ConsensusRequest:
@@ -158,10 +160,15 @@ def parse_request(payload: Any) -> ConsensusRequest:
         errors.append("'request_id' must be a string")
         request_id = ""
 
+    trace = payload.get("trace", False)
+    if not isinstance(trace, bool):
+        errors.append("'trace' must be a boolean")
+        trace = False
+
     unknown = sorted(
         set(payload)
         - {"issue", "agent_opinions", "method", "params", "seed", "evaluate",
-           "timeout_s", "request_id"}
+           "timeout_s", "request_id", "trace"}
     )
     if unknown:
         errors.append(f"unknown fields: {unknown}")
@@ -177,6 +184,7 @@ def parse_request(payload: Any) -> ConsensusRequest:
         evaluate=evaluate,
         timeout_s=float(timeout_s) if timeout_s is not None else None,
         request_id=request_id,
+        trace=trace,
     )
 
 

@@ -1,0 +1,146 @@
+"""CI smoke: watchdog trip -> flight-recorder blackbox dump.
+
+Arms a fake-backend serve stack with a hang fault on the first generate
+(the engine loop wedges inside the device call), lets the engine hang
+watchdog trip, and asserts the crash forensics the ISSUE-14 flight
+recorder promises:
+
+* the watchdog trip writes a parseable ``blackbox.json`` (atomic, schema
+  ``consensus_tpu.blackbox.v1``) whose ``reason`` is ``watchdog_trip``
+  and whose event ring holds the trip itself;
+* the trip is visible to operators in ``GET /healthz`` (``engine.
+  watchdog.wedged``).
+
+Exit 0 on success, 1 with a reason on any failed check.  Stdlib-only
+client, fake backend — no device, no network beyond loopback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _wait_for(predicate, timeout_s=10.0):
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def main() -> int:
+    from consensus_tpu.backends.fake import FakeBackend
+    from consensus_tpu.backends.faults import (
+        FaultInjectingBackend,
+        FaultPlan,
+        FaultSpec,
+    )
+    from consensus_tpu.obs.trace import get_flight_recorder
+    from consensus_tpu.serve.http_frontend import ConsensusServer
+    from consensus_tpu.serve.scheduler import RequestScheduler
+    from consensus_tpu.serve.service import ConsensusService
+
+    blackbox_path = os.path.join(
+        tempfile.mkdtemp(prefix="trace_smoke_"), "blackbox.json")
+    recorder = get_flight_recorder()
+    recorder.configure(blackbox_path)
+
+    plan = FaultPlan(seed=1, faults=[
+        FaultSpec(kind="hang", op="generate", call_index=0)])
+    faulty = FaultInjectingBackend(FakeBackend(), plan)
+    service = ConsensusService(faulty)
+    scheduler = RequestScheduler(
+        handler=service.run,
+        backend=faulty,
+        engine=True,
+        engine_options={"watchdog_timeout_s": 0.4},
+        default_timeout_s=30.0,
+    )
+    engine = scheduler.batching.engine
+    server = ConsensusServer(scheduler, port=0).start()
+    try:
+        payload = json.dumps({
+            "issue": "Should the town build a new library?",
+            "agent_opinions": {"A": "Yes, knowledge matters.",
+                               "B": "Only if the budget allows."},
+            "method": "best_of_n",
+            "params": {"n": 2, "max_tokens": 8},
+            "seed": 7,
+        }).encode("utf-8")
+
+        def fire():
+            request = urllib.request.Request(
+                server.base_url + "/v1/consensus", data=payload,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            try:
+                urllib.request.urlopen(request, timeout=30.0).read()
+            except Exception:
+                pass  # the wedged request is expected to fail
+
+        threading.Thread(target=fire, daemon=True).start()
+
+        if not _wait_for(lambda: faulty.hangs_active >= 1):
+            print("FAIL: hang fault never armed", file=sys.stderr)
+            return 1
+        if not _wait_for(lambda: engine.watchdog_trips >= 1):
+            print("FAIL: watchdog never tripped", file=sys.stderr)
+            return 1
+
+        with urllib.request.urlopen(
+            server.base_url + "/healthz", timeout=5.0
+        ) as response:
+            health = json.loads(response.read().decode("utf-8"))
+        watchdog = (health.get("engine") or {}).get("watchdog") or {}
+        if not (watchdog.get("enabled") and watchdog.get("wedged")):
+            print(f"FAIL: /healthz watchdog not wedged: {watchdog}",
+                  file=sys.stderr)
+            return 1
+
+        if not _wait_for(lambda: os.path.exists(blackbox_path)):
+            print("FAIL: blackbox.json never written", file=sys.stderr)
+            return 1
+        with open(blackbox_path, encoding="utf-8") as handle:
+            blackbox = json.load(handle)
+        if blackbox.get("schema") != "consensus_tpu.blackbox.v1":
+            print(f"FAIL: bad blackbox schema: {blackbox.get('schema')}",
+                  file=sys.stderr)
+            return 1
+        if blackbox.get("reason") != "watchdog_trip":
+            print(f"FAIL: bad dump reason: {blackbox.get('reason')}",
+                  file=sys.stderr)
+            return 1
+        kinds = [e.get("kind") for e in blackbox.get("events", [])]
+        if "watchdog_trip" not in kinds:
+            print(f"FAIL: no watchdog_trip event in ring: {kinds}",
+                  file=sys.stderr)
+            return 1
+
+        print(json.dumps({
+            "trace_smoke": "ok",
+            "blackbox": blackbox_path,
+            "reason": blackbox["reason"],
+            "events": len(blackbox.get("events", [])),
+            "iterations": len(blackbox.get("iterations", [])),
+            "watchdog_trips": engine.watchdog_trips,
+        }))
+        return 0
+    finally:
+        faulty.release_hangs()
+        server.stop(drain=False)
+        recorder.configure(None)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
