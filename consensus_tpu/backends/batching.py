@@ -74,7 +74,14 @@ class _Pending:
 
 
 class BatchingBackend:
-    """Merge concurrent sessions' backend calls into shared device batches."""
+    """Merge concurrent sessions' backend calls into shared device batches.
+
+    ``engine_options`` passes through to the decode engine verbatim —
+    notably ``{"decode_steps": K}`` turns on multi-token decode: the engine
+    dispatches K-step on-device decode windows per cohort
+    (``inner.generate_stream``) instead of one blocking ``generate`` call,
+    overlapping host admission/prefill with device decode.
+    """
 
     name = "batching"
 

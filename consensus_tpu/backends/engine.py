@@ -188,9 +188,24 @@ class DecodeEngine:
         prefix_cache_pages: Optional[int] = None,
         mesh: Optional[Any] = None,
         watchdog_timeout_s: Optional[float] = None,
+        decode_steps: Optional[int] = None,
     ):
         self.inner = inner
         self.n_slots = max(1, int(slots))
+        #: Multi-token decode (ROADMAP item 3): decode up to K tokens per
+        #: inner dispatch through the backend's ``generate_stream`` seam
+        #: instead of one blocking ``generate`` per cohort.  ``None`` (the
+        #: default) preserves the per-cohort blocking path byte-for-byte;
+        #: backends without a stream seam silently fall back to it.  The
+        #: per-cohort clamp the option promises is a PER-ROW MASK, not a
+        #: shorter program: rows whose remaining budget is under K freeze
+        #: mid-scan (they write only the sink page and emit pads), so one
+        #: compiled K-step program serves every budget mix.
+        self.decode_steps = (
+            max(1, int(decode_steps)) if decode_steps is not None else None
+        )
+        self._stream: Optional[Any] = None
+        self._stream_slots: List[Optional["_Slot"]] = []
         # Mesh mode: ``mesh`` is a {'dp': N, 'tp': M} dict, a "dp=4,tp=2"
         # string, or a MeshPlan.  Left unset, the engine inherits the inner
         # backend's mesh — a TPUBackend built over the full slice serves
@@ -357,6 +372,19 @@ class DecodeEngine:
             "engine_mfu_idle_fraction",
             "Fraction of engine wall time spent idle between iterations.",
         )
+        self._m_tokens_dispatch = reg.histogram(
+            "engine_tokens_per_dispatch",
+            "Generated tokens returned by one device dispatch (one K-step "
+            "multi-token window in stream mode; one whole cohort generate "
+            "in the legacy blocking path).",
+            buckets=DEFAULT_COUNT_BUCKETS,
+        )
+        self._m_host_iter_per_token = reg.gauge(
+            "engine_host_iterations_per_token",
+            "Engine iterations per generated token (ledger aggregate): 1.0 "
+            "means one host round-trip per token; decode_steps=K drives "
+            "this toward 1/K on decode-bound load.",
+        )
         #: Queued-call cancellations share the batching adapter's counter
         #: family so PR 1 dashboards keep one cancellation series.
         self._cancelled_counter = cancelled_counter
@@ -367,6 +395,12 @@ class DecodeEngine:
             "generate": 0, "score": 0, "next_token": 0, "embed": 0,
             "score_matrix": 0,
         }
+        #: Decode-window accounting: one "window" is one device dispatch
+        #: that can retire up to ``decode_steps`` tokens per row (a legacy
+        #: blocking generate counts as one window).  tokens/windows is the
+        #: per-dispatch amortization the multi-token path exists to raise.
+        self.decode_windows = 0
+        self.decoded_tokens = 0
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -413,7 +447,14 @@ class DecodeEngine:
         #: run_iteration) — no lock needed.
         self.ledger = IterationLedger()
         self._last_iter_end: Optional[float] = None
-        self._iter_device_s = 0.0
+        #: Device-time split (ROADMAP-3 / PR 15): ``dispatch_s`` is host
+        #: time spent ENQUEUEING device work (stream window launches),
+        #: ``block_s`` is time spent WAITING on device results (collect /
+        #: blocking inner calls).  On CPU backends the device runs
+        #: host-synchronously, so block_s absorbs device compute — the
+        #: caveat is stamped into ``mfu_attribution`` output itself.
+        self._iter_dispatch_s = 0.0
+        self._iter_block_s = 0.0
         self._iter_merge_s = 0.0
         self._iter_tokens = 0
 
@@ -553,6 +594,14 @@ class DecodeEngine:
                 "kv_pages_high_water": sum(p.high_water for p in pools),
                 "fused_search_sessions": self._search_sessions,
                 "fused_search_slots": self._search_slots,
+                "decode_steps": self.decode_steps,
+                "stream_active": self._stream is not None,
+                "decode_windows": self.decode_windows,
+                "decoded_tokens": self.decoded_tokens,
+                "tokens_per_dispatch_mean": (
+                    self.decoded_tokens / self.decode_windows
+                    if self.decode_windows else 0.0
+                ),
                 "backend_lost": self.backend_lost,
                 "mfu_attribution": self.ledger.mfu_attribution(),
                 "watchdog": {
@@ -604,6 +653,15 @@ class DecodeEngine:
 
     def _fail_all(self, exc: BaseException) -> None:
         """Stop-path cleanup (lock held): fail every queued/resident item."""
+        if self._stream is not None:
+            stream = self._stream
+            self._stream, self._stream_slots = None, []
+            close = getattr(stream, "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception:
+                    pass
         for row in self._gen_backlog:
             self._fail_item(row.item, exc)
         self._gen_backlog = []
@@ -625,7 +683,8 @@ class DecodeEngine:
             max(0.0, t_start - self._last_iter_end)
             if self._last_iter_end is not None else 0.0
         )
-        self._iter_device_s = 0.0
+        self._iter_dispatch_s = 0.0
+        self._iter_block_s = 0.0
         self._iter_merge_s = 0.0
         self._iter_tokens = 0
         with self._lock:
@@ -662,10 +721,21 @@ class DecodeEngine:
         # The busy window brackets exactly the calls that can silently
         # wedge — a dispatch that never returns leaves ``_busy_since`` set
         # and the watchdog converts the stall into ``backend_lost``.
-        if cohort or others:
+        # Stream mode: while a multi-token stream is in flight, the device
+        # already holds a dispatched K-step window (launched LAST iteration,
+        # after that iteration's host phases) — the sweep/admit/prefill
+        # block above just ran CONCURRENTLY with it under jax async
+        # dispatch.  ``_advance_stream`` now collects that window's tokens
+        # (the only blocking point), retires finished rows, and launches
+        # the next window before returning: D2H retirement and H2D
+        # admission double-buffer against device compute.
+        stream_active = self._stream is not None
+        if cohort or others or stream_active:
             self._busy_since = time.monotonic()
         try:
-            if cohort:
+            if stream_active:
+                self._advance_stream()
+            elif cohort:
                 self._dispatch_decode(cohort)
             for kind, items in others.items():
                 self._dispatch_other(kind, items)
@@ -677,7 +747,8 @@ class DecodeEngine:
                 start_s=t_start,
                 end_s=t_end,
                 idle_s=idle_s,
-                device_s=self._iter_device_s,
+                dispatch_s=self._iter_dispatch_s,
+                block_s=self._iter_block_s,
                 host={
                     "sweep": t1 - t0,
                     "admit": t2 - t1,
@@ -696,6 +767,10 @@ class DecodeEngine:
             self._m_mfu_device.set(mfu["device_fraction"])
             self._m_mfu_host.set(mfu["host_fraction"])
             self._m_mfu_idle.set(mfu["idle_fraction"])
+            if mfu["tokens"]:
+                self._m_host_iter_per_token.set(
+                    self.iterations / mfu["tokens"]
+                )
 
     def _watchdog_loop(self) -> None:
         """Monitor thread: trip when a dispatched inner call has made no
@@ -857,6 +932,12 @@ class DecodeEngine:
                 self._trace_row_event(slot.row, "prefill_complete")
 
     def _decode_cohort(self) -> List[_Slot]:
+        # One multi-token stream in flight at a time: newly-ready slots
+        # keep prefilling/waiting and form the NEXT cohort when the
+        # current stream drains (admission still overlaps device decode —
+        # that is the double-buffering, not a second stream).
+        if self._stream is not None:
+            return []
         ready = [s for s in self._slots if s is not None and s.state == _READY]
         prefilling = any(
             s is not None and s.state == _PREFILL for s in self._slots
@@ -876,6 +957,11 @@ class DecodeEngine:
     # -- dispatch (lock released) -------------------------------------------
 
     def _dispatch_decode(self, cohort: List[_Slot]) -> None:
+        if self.decode_steps is not None and callable(
+            getattr(self.inner, "generate_stream", None)
+        ):
+            self._open_stream(cohort)
+            return
         requests = [slot.row.request for slot in cohort]
         self.dispatch_counts["generate"] += 1
         for slot in cohort:
@@ -894,7 +980,8 @@ class DecodeEngine:
             batch_error = exc
             if isinstance(exc, BackendLostError):
                 self.backend_lost = True
-        self._iter_device_s += time.perf_counter() - t_dev
+        # A blocking inner call IS a wait on device results.
+        self._iter_block_s += time.perf_counter() - t_dev
 
         t_merge = time.perf_counter()
         with self._lock:
@@ -920,6 +1007,129 @@ class DecodeEngine:
                     self._record_row(item, slot.row.index, result, None)
             self._iter_tokens += tokens
             self._m_tokens_iter.observe(tokens)
+            self._m_tokens_dispatch.observe(tokens)
+            self.decode_windows += 1
+            self.decoded_tokens += tokens
+            self._work.notify_all()
+        self._iter_merge_s += time.perf_counter() - t_merge
+
+    # -- multi-token stream dispatch (lock released) --------------------------
+
+    def _open_stream(self, cohort: List[_Slot]) -> None:
+        """Start a K-step decode stream for this cohort: the inner backend
+        prefills the cohort and launches the FIRST K-step window; the call
+        returns as soon as the window is enqueued (jax async dispatch), so
+        the next iteration's host phases run while the device decodes."""
+        requests = [slot.row.request for slot in cohort]
+        self.dispatch_counts["generate"] += 1
+        for slot in cohort:
+            self._trace_row_event(
+                slot.row, "decode_dispatch", cohort=len(cohort),
+                decode_steps=self.decode_steps)
+        t_disp = time.perf_counter()
+        try:
+            stream = self.inner.generate_stream(
+                requests, decode_steps=self.decode_steps
+            )
+            stream.dispatch()
+        except Exception as exc:
+            self._iter_dispatch_s += time.perf_counter() - t_disp
+            if isinstance(exc, BackendLostError):
+                self.backend_lost = True
+            t_merge = time.perf_counter()
+            with self._lock:
+                for slot in cohort:
+                    self._retire(slot)
+                    self._trace_row_end(slot.row, outcome="error")
+                    self._fail_item(slot.row.item, exc)
+                self._work.notify_all()
+            self._iter_merge_s += time.perf_counter() - t_merge
+            return
+        self._iter_dispatch_s += time.perf_counter() - t_disp
+        self._stream = stream
+        self._stream_slots = list(cohort)
+
+    def _advance_stream(self) -> None:
+        """Collect the in-flight K-step window (the only point that blocks
+        on the device), retire rows that finished inside it, then launch
+        the next window — or drain the stream when every row is done."""
+        stream = self._stream
+        t_block = time.perf_counter()
+        try:
+            row_tokens, finished = stream.collect()
+        except Exception as exc:
+            self._iter_block_s += time.perf_counter() - t_block
+            if isinstance(exc, BackendLostError):
+                self.backend_lost = True
+            self._close_stream(error=exc)
+            return
+        self._iter_block_s += time.perf_counter() - t_block
+
+        t_merge = time.perf_counter()
+        with self._lock:
+            tokens = sum(row_tokens)
+            self._iter_tokens += tokens
+            self._m_tokens_iter.observe(tokens)
+            self._m_tokens_dispatch.observe(tokens)
+            self.decode_windows += 1
+            self.decoded_tokens += tokens
+            for i, result in finished.items():
+                slot = self._stream_slots[i]
+                if slot is None:
+                    continue
+                self._stream_slots[i] = None
+                if self._slots[slot.idx] is not slot:
+                    # Evicted mid-stream (cancellation sweep); the stream
+                    # kept masking the row on device — drop its result.
+                    continue
+                self._retire(slot)
+                ids = getattr(result, "token_ids", None) or ()
+                n_ids = len(ids) if ids else self._count_text_tokens(
+                    getattr(result, "text", "") or ""
+                )
+                self._trace_row_end(
+                    slot.row, outcome="retired", tokens=n_ids)
+                self._record_row(slot.row.item, slot.row.index, result, None)
+            self._work.notify_all()
+        self._iter_merge_s += time.perf_counter() - t_merge
+
+        if stream.finished:
+            self._stream = None
+            self._stream_slots = []
+            close = getattr(stream, "close", None)
+            if callable(close):
+                close()
+            return
+        t_disp = time.perf_counter()
+        try:
+            stream.dispatch()
+        except Exception as exc:
+            self._iter_dispatch_s += time.perf_counter() - t_disp
+            if isinstance(exc, BackendLostError):
+                self.backend_lost = True
+            self._close_stream(error=exc)
+            return
+        self._iter_dispatch_s += time.perf_counter() - t_disp
+
+    def _close_stream(self, error: BaseException) -> None:
+        """Tear down a failed stream: every row still riding it fails the
+        way a legacy batch error fails its cohort."""
+        stream, slots = self._stream, self._stream_slots
+        self._stream, self._stream_slots = None, []
+        close = getattr(stream, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
+        t_merge = time.perf_counter()
+        with self._lock:
+            for slot in slots:
+                if slot is None or self._slots[slot.idx] is not slot:
+                    continue
+                self._retire(slot)
+                self._trace_row_end(slot.row, outcome="error")
+                self._fail_item(slot.row.item, error)
             self._work.notify_all()
         self._iter_merge_s += time.perf_counter() - t_merge
 
@@ -959,7 +1169,7 @@ class DecodeEngine:
             try:
                 results = fn(dispatch)
             finally:
-                self._iter_device_s += time.perf_counter() - t_dev
+                self._iter_block_s += time.perf_counter() - t_dev
             if mapping is not None:
                 from consensus_tpu.backends.score_matrix import expand_deduped
 

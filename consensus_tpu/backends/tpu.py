@@ -616,6 +616,22 @@ class TPUBackend:
         # the transient max_batch_rows exists to bound).
         return self._sliced(requests, self._generate_impl, limit=256)
 
+    def generate_stream(
+        self, requests: Sequence[GenerationRequest], decode_steps: int = 1
+    ) -> "_PagedGenerateStream":
+        """Multi-token decode stream (engine ``decode_steps`` seam).
+
+        Prefills the cohort into a private page pool and then serves it in
+        K-step windows of ``models/stepper.py:paged_decode_steps``:
+        ``dispatch()`` enqueues one window and returns without fetching
+        (jax async dispatch — on TPU the host is free while the device
+        decodes), ``collect()`` fetches the window's token/emitted arrays
+        and finalizes rows that froze inside it with the exact
+        ``_finish_generation`` semantics.  Sampling replays the sequential
+        per-row key-split schedule, so emitted tokens are independent of K.
+        """
+        return _PagedGenerateStream(self, list(requests), decode_steps)
+
     def _seg_len_for(self, max_new: int) -> Optional[int]:
         """Segment length for a decode budget, or None for monolithic.
 
@@ -1745,6 +1761,277 @@ def _embed_forward(params, config: ModelConfig, tokens, valid):
         mask.sum(1), 1.0
     )
     return pooled
+
+
+#: Page size of the multi-token decode stream's private pool.  16 keeps the
+#: per-cohort page count fine-grained enough that short requests don't
+#: strand KV while staying a multiple of common TPU sublane tiles.
+_STREAM_PAGE_SIZE = 16
+#: Fixed prefill chunk width — ONE prefill program per (rows, pages) shape
+#: instead of one per prompt-length bucket.
+_STREAM_PREFILL_CHUNK = 64
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def _stream_logits(params, config: ModelConfig, hidden):
+    from consensus_tpu.models.transformer import project_logits
+
+    return project_logits(params, config, hidden)
+
+
+class _PagedGenerateStream:
+    """One generate cohort served as K-step decode windows.
+
+    Construction prefills every prompt into a PRIVATE page pool (contiguous
+    block tables, fixed-width chunks) and projects the first sampling
+    logits.  After that the protocol is the engine's stream seam:
+
+    - ``dispatch()`` enqueues ONE ``paged_decode_steps`` window and returns
+      without fetching anything — under jax async dispatch the host gets
+      control back while the device runs, so the engine overlaps its
+      sweep/admit/prefill phases with decode.
+    - ``collect()`` fetches the pending window's small host-facing arrays
+      (tokens / emitted / done / hit_eos), extends per-row ids, and returns
+      ``(per_row_token_counts, {row: GenerationResult})`` for rows that
+      froze inside the window, finalized with the exact
+      ``_finish_generation`` semantics (max_tokens truncation, stop
+      strings, token accounting).
+    - ``finished`` / ``close()`` manage drain and teardown.
+
+    Sampling state (keys, budgets, presence) comes from the SAME
+    ``_prep_generation_rows`` the dense paths use, and the in-scan sampler
+    replays the sequential key-split schedule — so emitted tokens match the
+    dense paths for any ``decode_steps``, up to paged-vs-dense forward
+    numerics.  Rows/pages/blocks are bucketed so cohort shape variety maps
+    to a small reused program set.
+    """
+
+    def __init__(
+        self,
+        backend: "TPUBackend",
+        requests: List[GenerationRequest],
+        decode_steps: int,
+    ):
+        from consensus_tpu.models import stepper
+        from consensus_tpu.models.generate import _prompt_presence
+
+        self._stepper = stepper
+        be = backend
+        self.backend = be
+        self.requests = requests
+        self.decode_steps = max(1, int(decode_steps))
+        self._mesh = be.mesh_plan.mesh if be.mesh_plan is not None else None
+        self._pending = None
+        self._closed = False
+        self._finished_rows: set = set()
+        self._results: Dict[int, GenerationResult] = {}
+
+        be.call_counts["generate"] += len(requests)
+        tok = be.tokenizer
+        prompt_ids = [
+            tok.encode(be._render_prompt(r), add_bos=True)[-be.max_context :]
+            for r in requests
+        ]
+        (target, pad_rows, temperatures, bias_table, bias_index, keys,
+         eos_ids, rep_penalty) = be._prep_generation_rows(
+            requests, allowed=_bucket(len(requests), minimum=8)
+        )
+        self._n_rows = len(requests)
+        self._ids: List[List[int]] = [[] for _ in requests]
+
+        # Contiguous block tables over a bucketed private pool: each row
+        # reserves ceil((prompt + max_tokens) / page) pages AT DISPATCH TIME
+        # — every page the in-scan cursor can reach exists before the first
+        # window runs.  The eos-check token never needs one (sink).
+        ps = _STREAM_PAGE_SIZE
+        pages_per = [
+            -(-(len(ids) + r.max_tokens) // ps)
+            for ids, r in zip(prompt_ids, requests)
+        ] + [1] * pad_rows
+        max_blocks = _bucket(max(pages_per), minimum=8)
+        num_pages = min(
+            _width_bucket(sum(pages_per), minimum=16),
+            target * max_blocks,
+        )
+        tables = np.full((target, max_blocks), -1, np.int32)
+        off = 0
+        for row, n in enumerate(pages_per):
+            tables[row, :n] = np.arange(off, off + n)
+            off += n
+        be.instruments.record_launch(
+            "generate_stream",
+            (target, num_pages, max_blocks, self.decode_steps),
+        )
+
+        state = stepper.make_page_state(
+            be.config, num_pages, ps,
+            dtype=jnp.dtype(be.params["embed"].dtype), mesh=self._mesh,
+        )
+        sink = num_pages
+        tables_j = jnp.asarray(tables)
+
+        # Fixed-width chunked prefill; per-row final-prompt hidden is
+        # accumulated with a last-chunk mask so ragged prompts share the
+        # same program.
+        chunk = _STREAM_PREFILL_CHUNK
+        maxlen = max(len(ids) for ids in prompt_ids)
+        lengths = np.zeros(target, np.int32)
+        final_hidden = None
+        for start in range(0, maxlen, chunk):
+            ctok = np.zeros((target, chunk), np.int32)
+            cval = np.zeros((target, chunk), bool)
+            wp = np.full((target, chunk), sink, np.int32)
+            wo = np.zeros((target, chunk), np.int32)
+            is_last = np.zeros(target, bool)
+            for row, ids in enumerate(prompt_ids):
+                piece = ids[start : start + chunk]
+                if not piece:
+                    continue
+                ctok[row, : len(piece)] = piece
+                cval[row, : len(piece)] = True
+                pos = start + np.arange(len(piece))
+                wp[row, : len(piece)] = tables[row, pos // ps]
+                wo[row, : len(piece)] = pos % ps
+                lengths[row] = start + len(piece)
+                is_last[row] = start + len(piece) >= len(ids)
+            hid, state = stepper.paged_prefill_chunk(
+                be.params, be.config, *be._place_batch(ctok, cval), state,
+                tables_j, jnp.asarray(lengths),
+                *be._place_batch(wp, wo), mesh=self._mesh,
+            )
+            mask = jnp.asarray(is_last)[:, None]
+            final_hidden = (
+                jnp.where(mask, hid, final_hidden)
+                if final_hidden is not None
+                else hid
+            )
+        be.instruments.record_padding(
+            "generate_trunk", target, -(-maxlen // chunk) * chunk,
+            int(sum(len(ids) for ids in prompt_ids)),
+        )
+
+        self._logits = _stream_logits(
+            be.params, be.config, final_hidden.astype(jnp.float32)
+        )
+        self._state = state
+        self._tables = tables_j
+        self._lengths = jnp.asarray(lengths)
+        self._keys = keys
+        # Bucket-pad rows start done with budget 0: they sample pad ids into
+        # the sink forever and never show up in collect().
+        row_pad = np.zeros(target, bool)
+        row_pad[len(requests) :] = True
+        self._done = jnp.asarray(row_pad)
+        self._budgets = jnp.asarray(
+            [r.max_tokens for r in requests] + [0] * pad_rows, jnp.int32
+        )
+        self._hit_eos = jnp.zeros(target, bool)
+        self._temperatures = temperatures
+        self._eos_ids = jnp.asarray(eos_ids, jnp.int32)
+        self._bias_table = bias_table
+        self._bias_index = bias_index
+        self._rep_penalty = rep_penalty
+        if rep_penalty is not None:
+            width = max(maxlen, 1)
+            ptok = np.full((target, width), tok.pad_id, np.int32)
+            pval = np.zeros((target, width), bool)
+            for row, ids in enumerate(prompt_ids):
+                ptok[row, width - len(ids) :] = ids
+                pval[row, width - len(ids) :] = True
+            self._presence = _prompt_presence(
+                jnp.asarray(ptok), jnp.asarray(pval), be.config.vocab_size
+            )
+        else:
+            self._presence = None
+
+    @property
+    def finished(self) -> bool:
+        return self._closed or len(self._finished_rows) >= self._n_rows
+
+    def dispatch(self) -> None:
+        """Enqueue one K-step window.  Returns without fetching — the
+        device arrays stay in flight until ``collect()``."""
+        if self._closed or self._pending is not None or self.finished:
+            return
+        (tokens, emitted, self._logits, self._state, self._lengths,
+         self._keys, self._done, self._budgets, self._hit_eos,
+         self._presence) = self._stepper.paged_decode_steps(
+            self.backend.params, self.backend.config, self._logits,
+            self._state, self._tables, self._lengths, self._keys,
+            self._done, self._budgets, self._hit_eos,
+            temperature=self._temperatures, eos_ids=self._eos_ids,
+            num_steps=self.decode_steps,
+            bias_table=self._bias_table, bias_index=self._bias_index,
+            pad_id=self.backend.tokenizer.pad_id,
+            presence=self._presence, rep_penalty=self._rep_penalty,
+            mesh=self._mesh,
+        )
+        self._pending = (tokens, emitted, self._done, self._hit_eos)
+
+    def collect(self) -> Tuple[List[int], Dict[int, GenerationResult]]:
+        """Block on the pending window; return (per-row emitted counts,
+        {row: result}) for rows that froze inside it."""
+        if self._pending is None:
+            raise RuntimeError("collect() before dispatch()")
+        be = self.backend
+        tokens, emitted, done, hit = be._fetch(*self._pending)
+        self._pending = None
+        row_tokens = [0] * self._n_rows
+        newly_finished: Dict[int, GenerationResult] = {}
+        for row in range(self._n_rows):
+            if row in self._finished_rows:
+                continue
+            ids = [int(t) for t, e in zip(tokens[row], emitted[row]) if e]
+            self._ids[row].extend(ids)
+            row_tokens[row] = len(ids)
+            if bool(done[row]):
+                self._finished_rows.add(row)
+                result = self._finish_row(row, bool(hit[row]))
+                self._results[row] = result
+                newly_finished[row] = result
+        if self.finished:
+            be.instruments.record_padding(
+                "generate_decode", self._n_rows,
+                max((r.max_tokens for r in self.requests), default=0),
+                sum(len(ids) for ids in self._ids),
+            )
+        return row_tokens, newly_finished
+
+    def _finish_row(self, row: int, hit_eos: bool) -> GenerationResult:
+        """Per-row ``_finish_generation``: same truncation, stop-string,
+        finish-reason, and token-accounting semantics."""
+        be = self.backend
+        request = self.requests[row]
+        emitted = len(self._ids[row])
+        ids = self._ids[row][: request.max_tokens]
+        text = be.tokenizer.decode(ids)
+        finish = (
+            "stop" if (hit_eos and emitted <= request.max_tokens) else "length"
+        )
+        truncated = False
+        if not be.pin_generation_budget:
+            for stop in request.stop:
+                idx = text.find(stop)
+                if idx >= 0:
+                    text = text[:idx]
+                    finish = "stop"
+                    truncated = True
+        if truncated:
+            ids = be.tokenizer.encode(text)
+        be.token_counts["generated"] += len(ids)
+        return GenerationResult(
+            text=text, token_ids=tuple(ids), finish_reason=finish
+        )
+
+    def results(self) -> List[GenerationResult]:
+        """All results in request order (valid once ``finished``)."""
+        return [self._results[row] for row in range(self._n_rows)]
+
+    def close(self) -> None:
+        self._closed = True
+        self._pending = None
+        self._state = None
+        self._logits = None
 
 
 class TPUTokenSearchSession:

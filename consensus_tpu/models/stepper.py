@@ -57,6 +57,7 @@ from consensus_tpu.models.transformer import (
     apply_rope,
     _softcap,
 )
+from consensus_tpu.models.sampling import sample_tokens
 from consensus_tpu.ops.decode_attention import paged_attention
 from consensus_tpu.ops.welfare import (
     DEFAULT_REWARD,
@@ -865,6 +866,142 @@ def paged_decode_step(
     state = _constrain_state(state, mesh)
     logits = project_logits(params, config, hidden[:, 0, :])
     return _constrain(logits, mesh, "data", "model"), state
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "num_steps", "top_k", "top_p", "pad_id", "mesh"),
+    donate_argnums=(3,),
+)
+def paged_decode_steps(
+    params,
+    config: ModelConfig,
+    logits: jax.Array,  # (B, V) f32 — sampling logits carried IN (prefill out)
+    state: PagedSlotState,
+    block_tables: jax.Array,  # (B, max_blocks) int32, -1 padded
+    lengths: jax.Array,  # (B,) int32 — tokens WRITTEN so far (excl. this window)
+    keys: jax.Array,  # (B, 2) per-row PRNG keys
+    done: jax.Array,  # (B,) bool — frozen rows (EOS'd / budget-spent / pads)
+    budgets: jax.Array,  # (B,) int32 — remaining emit budget (max_tokens left)
+    hit_eos: jax.Array,  # (B,) bool — row sampled EOS within budget
+    temperature: jax.Array,  # (B,) float32 (or scalar)
+    eos_ids: Optional[jax.Array] = None,  # (E,) int32
+    num_steps: int = 1,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    logit_bias: Optional[jax.Array] = None,  # (V,) or (B, V) additive
+    bias_table: Optional[jax.Array] = None,
+    bias_index: Optional[jax.Array] = None,
+    pad_id: int = 0,
+    presence: Optional[jax.Array] = None,  # (B, V) bool seen-token mask
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32
+    mesh: Optional[Mesh] = None,  # static: slots over data, KV/vocab over model
+):
+    """Decode up to ``num_steps`` tokens per slot in ONE dispatch.
+
+    A ``lax.scan`` over ``paged_decode_step``'s body: each step samples from
+    the carried logits with the SAME per-row key-split schedule as the
+    sequential loops (``_decode_segment`` splits every row's key once per
+    step, done rows included, and a row's t-th emitted token is always drawn
+    from its t-th split — so K=8 is byte-identical to K=1 and to the dense
+    paths, up to forward numerics), then advances one position through the
+    paged forward.
+
+    Early exit is a MASK, not a loop break: a row freezes when it samples
+    EOS or when its budget was already spent at step start.  Frozen rows
+    keep splitting keys (schedule replay), sample pad ids, write K/V only to
+    the sink page, and stop advancing ``lengths`` — so their block-table
+    pages beyond the frozen cursor are never touched.  The one extra sample
+    at ``budgets == 0`` is the eos-check step: it decides ``hit_eos`` (stop
+    vs length finish) exactly like the sequential path, whose bucketed
+    windows also sample past the request budget before the host truncates.
+
+    Page cursors advance IN-SCAN: step writes go to
+    ``block_tables[b, lengths[b] // page_size]`` at offset ``lengths[b] %
+    page_size``, so a window may cross page boundaries mid-scan — every
+    page it can reach was reserved at dispatch time (the engine books
+    ``max_tokens`` worth of pages at cohort admission) and the eos-check
+    token itself lands in the sink, never in a pool page.
+
+    Returns ``(tokens (B, K), emitted (B, K), logits, state, lengths, keys,
+    done, budgets, hit_eos, presence)`` — the trailing tuple re-enters the
+    next window's dispatch unchanged, so the host only ever fetches
+    ``tokens``/``emitted``/``done`` (small int/bool arrays) and the KV state
+    never crosses the device boundary.
+    """
+    batch = logits.shape[0]
+    page_size = state.k_pages.shape[2]
+    sink = state.k_pages.shape[1] - 1
+    max_blocks = block_tables.shape[1]
+    if eos_ids is None:
+        eos_ids = jnp.zeros((0,), jnp.int32)
+    if bias_table is not None:
+        logit_bias = bias_table[bias_index]
+    logits = _constrain(logits, mesh, "data", "model")
+    block_tables = _constrain(block_tables, mesh, "data", None)
+    lengths = _constrain(lengths, mesh, "data")
+    keys = _constrain(keys, mesh, "data", None)
+    done = _constrain(done, mesh, "data")
+    budgets = _constrain(budgets, mesh, "data")
+    hit_eos = _constrain(hit_eos, mesh, "data")
+    state = _constrain_state(state, mesh)
+    use_rp = presence is not None and rep_penalty is not None
+
+    def is_eos(token: jax.Array) -> jax.Array:
+        if eos_ids.shape[0] == 0:
+            return jnp.zeros_like(token, dtype=jnp.bool_)
+        return jnp.any(token[:, None] == eos_ids[None, :], axis=-1)
+
+    def step(carry, _):
+        (logits, state, lengths, keys, done, budgets, hit_eos) = carry[:7]
+        pres = carry[7] if use_rp else None
+        pairs = jax.vmap(jax.random.split)(keys)
+        keys, sub = pairs[:, 0], pairs[:, 1]
+        token = sample_tokens(
+            sub, logits, temperature=temperature, top_k=top_k, top_p=top_p,
+            logit_bias=logit_bias,
+            presence=pres, rep_penalty=rep_penalty if use_rp else None,
+        )
+        token = jnp.where(done, pad_id, token)
+        if use_rp:
+            pres = pres.at[jnp.arange(batch), token].set(True)
+        token_is_eos = is_eos(token) & ~done
+        emitted = ~done & ~token_is_eos & (budgets > 0)
+        new_done = done | token_is_eos | (budgets <= 0)
+        hit_eos = hit_eos | token_is_eos
+        budgets = budgets - emitted.astype(jnp.int32)
+
+        page_idx = jnp.minimum(lengths // page_size, max_blocks - 1)
+        page = jnp.take_along_axis(
+            block_tables, page_idx[:, None], axis=1
+        )[:, 0]
+        write_pages = jnp.where(new_done | (page < 0), sink, page)
+        write_offsets = jnp.where(new_done, 0, lengths % page_size)
+        attn_lengths = jnp.where(new_done, lengths, lengths + 1)
+        hidden, state = _paged_forward(
+            params, config, token[:, None], lengths[:, None], state,
+            block_tables, attn_lengths,
+            write_pages[:, None], write_offsets[:, None],
+        )
+        state = _constrain_state(state, mesh)
+        logits = project_logits(params, config, hidden[:, 0, :])
+        logits = _constrain(logits, mesh, "data", "model")
+        out = (logits, state, attn_lengths, keys, new_done, budgets, hit_eos)
+        return out + ((pres,) if use_rp else ()), (token, emitted)
+
+    init = (logits, state, lengths, keys, done, budgets, hit_eos) + (
+        (presence,) if use_rp else ()
+    )
+    final, (tokens_steps, emitted_steps) = jax.lax.scan(
+        step, init, None, length=num_steps
+    )
+    (logits, state, lengths, keys, done, budgets, hit_eos) = final[:7]
+    presence = final[7] if use_rp else None
+    return (
+        jnp.swapaxes(tokens_steps, 0, 1),  # (B, K) int32
+        jnp.swapaxes(emitted_steps, 0, 1),  # (B, K) bool
+        logits, state, lengths, keys, done, budgets, hit_eos, presence,
+    )
 
 
 @functools.partial(

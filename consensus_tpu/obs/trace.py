@@ -391,6 +391,8 @@ class IterationLedger:
         self._iterations = 0
         self._tokens = 0
         self._device_s = 0.0
+        self._dispatch_s = 0.0
+        self._block_s = 0.0
         self._idle_s = 0.0
         self._host_s = {p: 0.0 for p in self.HOST_PHASES}
         self._first_start: Optional[float] = None
@@ -402,13 +404,23 @@ class IterationLedger:
         start_s: float,
         end_s: float,
         idle_s: float,
-        device_s: float,
+        device_s: float = 0.0,
+        dispatch_s: float = 0.0,
+        block_s: float = 0.0,
         host: Dict[str, float],
         tokens: int = 0,
         cohort: int = 0,
         queue_depth: int = 0,
         pages_in_use: int = 0,
     ) -> Dict[str, Any]:
+        # ``device_s`` is the legacy fused bracket around a blocking inner
+        # call; callers that time async dispatch separately pass
+        # ``dispatch_s`` (host time to enqueue device work) and ``block_s``
+        # (time spent waiting on device results).  A legacy ``device_s``
+        # books as pure block time — a blocking call IS a wait.
+        dispatch_s = max(0.0, dispatch_s)
+        block_s = max(0.0, block_s) + max(0.0, device_s)
+        device_s = dispatch_s + block_s
         total = max(0.0, end_s - start_s)
         known_host = sum(max(0.0, host.get(p, 0.0)) for p in self.HOST_PHASES if p != "other")
         other = max(0.0, total - device_s - known_host)
@@ -417,6 +429,8 @@ class IterationLedger:
             "total_s": round(total, 6),
             "idle_s": round(max(0.0, idle_s), 6),
             "device_s": round(max(0.0, device_s), 6),
+            "dispatch_s": round(dispatch_s, 6),
+            "block_s": round(block_s, 6),
             "host_s": {
                 **{p: round(max(0.0, host.get(p, 0.0)), 6) for p in self.HOST_PHASES if p != "other"},
                 "other": round(other, 6),
@@ -431,6 +445,8 @@ class IterationLedger:
             row["iteration"] = self._iterations
             self._tokens += int(tokens)
             self._device_s += max(0.0, device_s)
+            self._dispatch_s += dispatch_s
+            self._block_s += block_s
             self._idle_s += max(0.0, idle_s)
             for p in self.HOST_PHASES:
                 if p == "other":
@@ -453,6 +469,8 @@ class IterationLedger:
             iterations = self._iterations
             tokens = self._tokens
             device_s = self._device_s
+            dispatch_s = self._dispatch_s
+            block_s = self._block_s
             idle_s = self._idle_s
             host = dict(self._host_s)
             first = self._first_start
@@ -470,14 +488,29 @@ class IterationLedger:
             "tokens": tokens,
             "wall_s": round(wall_s, 6),
             "device_s": round(device_s, 6),
+            "dispatch_s": round(dispatch_s, 6),
+            "block_s": round(block_s, 6),
             "host_s": round(host_s, 6),
             "idle_s": round(idle_s, 6),
             "device_fraction": round(device_s / denom, 4),
+            "dispatch_fraction": round(dispatch_s / denom, 4),
+            "block_fraction": round(block_s / denom, 4),
             "host_fraction": round(host_s / denom, 4),
             "idle_fraction": round(idle_s / denom, 4),
             "host_breakdown": {k: round(v, 6) for k, v in host.items()},
             "coverage": round(accounted / denom, 4),
             "tokens_per_device_s": round(tokens / device_s, 2) if device_s > 0 else 0.0,
+            # The split is only meaningful under real async dispatch: on the
+            # CPU backend the "device" executes host-synchronously, so
+            # block_s contains the device compute itself and
+            # device_fraction ~1.0 / host_fraction ~0 say nothing about
+            # host-loop overhead — read those numbers from a TPU run.
+            "note": (
+                "dispatch_s = host enqueue time, block_s = waiting on device "
+                "results; on CPU backends device execution is "
+                "host-synchronous, so block_s includes device compute and "
+                "the device/host split requires a TPU run to be meaningful."
+            ),
         }
 
 

@@ -139,6 +139,13 @@ def main(argv=None) -> int:
                         help="(self-contained) JSON object of extra "
                              "DecodeEngine options (slots, num_pages, "
                              "prefix_cache_pages, ...)")
+    parser.add_argument("--decode-steps", type=int, default=None,
+                        metavar="K",
+                        help="(self-contained) multi-token decode: the "
+                             "engine dispatches K-step on-device decode "
+                             "windows per cohort instead of one blocking "
+                             "call per token (implies --engine; shorthand "
+                             'for --engine-options \'{"decode_steps": K}\')')
     parser.add_argument("--mesh", default=None, metavar="dp=N,tp=M",
                         help="(self-contained) serve over the (data, model) "
                              "device mesh: the decode engine partitions its "
@@ -236,6 +243,8 @@ def main(argv=None) -> int:
         engine_options = json.loads(args.engine_options) or {}
         if args.prefix_cache:
             engine_options.setdefault("prefix_cache", True)
+        if args.decode_steps is not None:
+            engine_options.setdefault("decode_steps", args.decode_steps)
         fleet_options = json.loads(args.fleet_options) or {}
         if args.elastic or args.autoscale:
             fleet_options.setdefault("elastic", True)
