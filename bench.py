@@ -1071,6 +1071,106 @@ def main() -> None:
                     "handoff); replica count monotone per phase",
         }}
 
+    # ---- BENCH_OBS: welfare telemetry plane cost + federation proof ------
+    # Two claims measured: (1) the telemetry plane (latency + welfare
+    # quantile sketches, drift detector, SLO engine) costs < 2% serve
+    # throughput vs the same stack with it off; (2) the fleet-federated
+    # /metrics p99 from merged per-replica sketches EQUALS the quantile of
+    # one sketch fed the pooled observations (merge is exact integer
+    # bucket addition, so this is equality, not approximation).
+    # BENCH_OBS=0 skips.
+    obs_extra = {}
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        import copy as _copy
+
+        from consensus_tpu.obs.metrics import Registry as _Registry
+        from consensus_tpu.obs.sketch import (
+            merge_sketch_series,
+            quantile_from_series,
+        )
+        from consensus_tpu.obs.welfare import set_welfare_sink
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+
+        obs_requests = int(os.environ.get("BENCH_OBS_REQUESTS", "32"))
+        obs_rate = float(os.environ.get("BENCH_OBS_RATE", "50"))
+        obs_payloads = scenario_requests(
+            obs_requests, params={"n": 4, "max_tokens": NEW_TOKENS},
+            evaluate=True,
+        )
+
+        def _obs_run(telemetry_on):
+            registry = _Registry()
+            server = create_server(
+                backend="fake", port=0, registry=registry, max_inflight=4,
+                telemetry=telemetry_on, slo=telemetry_on,
+            ).start()
+            try:
+                report = run_loadgen(
+                    server.base_url, obs_payloads, rate_rps=obs_rate)
+            finally:
+                server.stop()
+                set_welfare_sink(None)
+            return report
+
+        report_off = _obs_run(False)
+        report_on = _obs_run(True)
+        overhead = (
+            1.0 - report_on["throughput_rps"] / report_off["throughput_rps"]
+            if report_off["throughput_rps"] else 0.0
+        )
+
+        # Federation proof on a 3-replica fleet: merged fleet p99 must
+        # equal the pooled-observation p99 bit-for-bit.
+        fleet_registry = _Registry()
+        fleet_server = create_server(
+            backend="fake", port=0, registry=fleet_registry, max_inflight=4,
+            fleet_size=3, telemetry=True,
+        ).start()
+        try:
+            run_loadgen(fleet_server.base_url, obs_payloads,
+                        rate_rps=obs_rate)
+            fed = fleet_server.scheduler.federated_metrics_snapshot()
+        finally:
+            fleet_server.stop()
+            set_welfare_sink(None)
+        family = fed["families"]["serve_latency_sketch_seconds"]
+        pooled = None
+        merged = None
+        replicas_seen = set()
+        for series in family["series"]:
+            body = {k: v for k, v in series.items() if k != "labels"}
+            if series["labels"].get("replica") == "fleet":
+                if merged is None:
+                    merged = _copy.deepcopy(body)
+                else:
+                    merge_sketch_series(merged, body, family["extreme"])
+            else:
+                replicas_seen.add(series["labels"].get("replica"))
+                if pooled is None:
+                    pooled = _copy.deepcopy(body)
+                else:
+                    merge_sketch_series(pooled, body, family["extreme"])
+        ra = family["relative_accuracy"]
+        p99_merged = quantile_from_series(merged, 0.99, ra)
+        p99_pooled = quantile_from_series(pooled, 0.99, ra)
+        obs_extra = {"bench_obs": {
+            "throughput_off_rps": report_off["throughput_rps"],
+            "throughput_on_rps": report_on["throughput_rps"],
+            "telemetry_overhead_frac": round(overhead, 4),
+            "within_2pct": overhead < 0.02,
+            "fleet_replicas_observed": len(replicas_seen),
+            "fleet_p99_merged_ms": round(p99_merged * 1e3, 3),
+            "fleet_p99_pooled_ms": round(p99_pooled * 1e3, 3),
+            "merged_equals_pooled": p99_merged == p99_pooled,
+            "exemplars": len(merged.get("exemplars", [])),
+            "requests_per_run": obs_requests,
+            "offered_rate_rps": obs_rate,
+            "goal": "telemetry plane < 2% throughput cost; fleet-merged "
+                    "p99 exactly equals pooled-observation p99 (exact "
+                    "sketch merge)",
+        }}
+
     bench_tokens = {
         k: tokens_after[k] - tokens_before[k] for k in tokens_after
     }
@@ -1197,6 +1297,7 @@ def main() -> None:
                     **mesh_extra,
                     **score_extra,
                     **elastic_extra,
+                    **obs_extra,
                     "weights": "random",
                     "quantization": backend.quantization or "bf16",
                     "shared_context_scoring": backend.shared_context_scoring,

@@ -592,6 +592,20 @@ class DecodeEngine:
                 "kv_pages_in_use": sum(p.pages_in_use for p in pools),
                 "kv_pages_reserved": sum(self._reserved),
                 "kv_pages_high_water": sum(p.high_water for p in pools),
+                # Fraction of the page pool not in use or reserved — the
+                # capacity signal the kv_headroom SLO (obs/slo.py) watches.
+                "kv_page_headroom": round(
+                    max(
+                        0.0,
+                        1.0
+                        - (
+                            sum(p.pages_in_use for p in pools)
+                            + sum(self._reserved)
+                        )
+                        / max(1, sum(p.num_pages for p in pools)),
+                    ),
+                    4,
+                ),
                 "fused_search_sessions": self._search_sessions,
                 "fused_search_slots": self._search_slots,
                 "decode_steps": self.decode_steps,

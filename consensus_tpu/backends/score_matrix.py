@@ -46,6 +46,7 @@ from consensus_tpu.backends.base import (
     ScoreRequest,
     ScoreResult,
 )
+from consensus_tpu.obs.welfare import get_welfare_sink
 from consensus_tpu.ops.welfare import (
     DEFAULT_REWARD,
     WELFARE_RULES,
@@ -280,11 +281,22 @@ def matrix_metrics(registry=None):
     return cells, d2h, agents_hist
 
 
-def record_matrix(result: ScoreMatrixResult, n_agents: int, registry=None):
+def record_matrix(
+    result: ScoreMatrixResult,
+    n_agents: int,
+    registry=None,
+    welfare_rule: Optional[str] = None,
+):
     cells, d2h, agents_hist = matrix_metrics(registry)
     cells.inc(result.cells)
     d2h.inc(result.d2h_bytes)
     agents_hist.observe(n_agents)
+    # Welfare telemetry plane (PR 16): when a server installed a sink, the
+    # chosen candidate's welfare + worst-agent utility feed the
+    # score-path sketches.  Off (the default) this is one global read.
+    sink = get_welfare_sink()
+    if sink is not None:
+        sink.record_matrix(result, welfare_rule)
 
 
 # ---------------------------------------------------------------------------
@@ -318,7 +330,9 @@ def fallback_score_matrix_many(
     out = []
     for request, (lo, hi) in zip(requests, spans):
         matrix = reduce_matrix(request, results[lo:hi], path="fallback")
-        record_matrix(matrix, len(request.agents))
+        record_matrix(
+            matrix, len(request.agents), welfare_rule=request.welfare_rule
+        )
         out.append(matrix)
     return out
 

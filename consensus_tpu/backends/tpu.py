@@ -1367,7 +1367,11 @@ class TPUBackend:
                 self.matrix_stats["fallbacks"] += 1
                 result = fallback_score_matrix_many(self, [request])[0]
             else:
-                record_matrix(result, len(request.agents))
+                record_matrix(
+                    result,
+                    len(request.agents),
+                    welfare_rule=request.welfare_rule,
+                )
             out.append(result)
         return out
 

@@ -37,6 +37,19 @@ from consensus_tpu.obs.metrics import (
     exponential_buckets,
     get_registry,
     merge_snapshots,
+    prometheus_text,
+)
+from consensus_tpu.obs.sketch import (
+    QuantileSketch,
+    federate_snapshot,
+    quantile_from_series,
+)
+from consensus_tpu.obs.slo import SLOEngine, SLOSpec
+from consensus_tpu.obs.welfare import (
+    ServeTelemetry,
+    WelfareDriftDetector,
+    get_welfare_sink,
+    set_welfare_sink,
 )
 from consensus_tpu.obs.spans import SpanTracer, diff_span_paths, get_span_tracer
 from consensus_tpu.obs.trace import (
@@ -57,21 +70,31 @@ __all__ = [
     "DEFAULT_TIME_BUCKETS",
     "FlightRecorder",
     "IterationLedger",
+    "QuantileSketch",
     "Registry",
     "RollingWindow",
+    "SLOEngine",
+    "SLOSpec",
+    "ServeTelemetry",
     "SpanTracer",
     "TraceContext",
     "TraceStore",
+    "WelfareDriftDetector",
     "bucket_recompiles",
     "diff_snapshots",
     "diff_span_paths",
     "exponential_buckets",
+    "federate_snapshot",
     "get_flight_recorder",
     "get_registry",
     "get_span_tracer",
     "get_trace_store",
+    "get_welfare_sink",
     "merge_snapshots",
     "padding_efficiency",
+    "prometheus_text",
+    "quantile_from_series",
+    "set_welfare_sink",
     "trace_current",
     "use_trace",
 ]

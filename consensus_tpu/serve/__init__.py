@@ -56,6 +56,10 @@ def create_server(
     fleet_size: int = 1,
     fleet_options=None,
     mesh=None,
+    telemetry: bool = False,
+    telemetry_options=None,
+    slo=False,
+    slo_options=None,
 ) -> ConsensusServer:
     """Wire backend → service → scheduler → HTTP server (not yet started).
 
@@ -113,10 +117,30 @@ def create_server(
     and page pools over the dp replicas (``--mesh`` on the CLI).  Non-TPU
     backends only see the engine-side partitioning.
 
+    ``telemetry=True`` installs a
+    :class:`~consensus_tpu.obs.welfare.ServeTelemetry` sink: latency and
+    welfare quantile sketches (mergeable, replica-labelled), per-tier
+    degraded-vs-full welfare-gap gauges, and the fairness drift detector.
+    ``slo=True`` (or a sequence of spec dicts) runs an
+    :class:`~consensus_tpu.obs.slo.SLOEngine` over the request stream plus
+    polled ``kv_headroom``/``welfare_drift`` signals, served at ``/v1/slo``
+    and inside ``/healthz``.  Both default OFF: with them off the serving
+    path takes zero extra allocations and responses stay byte-identical
+    (pinned in tests/test_welfare_telemetry.py).
+
     Resilience/brownout/fleet features default OFF so a quiet server's
     responses stay byte-identical to offline Experiment runs (pinned in
     tests/test_serve.py — the engine default keeps that identity)."""
     from consensus_tpu.backends import get_backend, wrap_backend
+
+    telemetry_obj = None
+    if telemetry:
+        from consensus_tpu.obs.welfare import ServeTelemetry, set_welfare_sink
+
+        telemetry_obj = ServeTelemetry(
+            registry=registry, **dict(telemetry_options or {})
+        )
+        set_welfare_sink(telemetry_obj)
 
     if mesh is not None:
         from consensus_tpu.parallel.mesh import parse_mesh_spec
@@ -148,6 +172,9 @@ def create_server(
             engine_options=engine_options,
             fleet_size=max(1, fleet_size),
             fleet_options=dict(fleet_options or {}),
+            telemetry_obj=telemetry_obj,
+            slo=slo,
+            slo_options=slo_options,
         )
 
     inner = get_backend(backend, **(backend_options or {}))
@@ -178,8 +205,73 @@ def create_server(
         anytime_margin_s=anytime_margin_s,
         engine=engine,
         engine_options=engine_options,
+        telemetry=telemetry_obj,
     )
-    return ConsensusServer(scheduler, host=host, port=port, registry=registry)
+    slo_engine = _build_slo_engine(
+        slo, slo_options, registry, scheduler.stats, telemetry_obj
+    )
+    return ConsensusServer(
+        scheduler, host=host, port=port, registry=registry,
+        slo_engine=slo_engine, telemetry=telemetry_obj,
+    )
+
+
+def _kv_headroom_signal(stats_fn):
+    """Poll signal: min KV-page headroom across whatever ``stats_fn`` sees.
+
+    Single-scheduler stats carry an ``engine`` block; router stats carry
+    ``fleet.replicas.<name>.engine``.  Returns None (sample skipped) when
+    no engine stats are available — e.g. the legacy flush path."""
+    def signal():
+        try:
+            stats = stats_fn()
+        except Exception:
+            return None
+        engine_stats = stats.get("engine")
+        if isinstance(engine_stats, dict):
+            value = engine_stats.get("kv_page_headroom")
+            if value is not None:
+                return value
+        fleet = stats.get("fleet")
+        if isinstance(fleet, dict):
+            values = []
+            for rep in fleet.get("replicas", {}).values():
+                if not isinstance(rep, dict):
+                    continue
+                eng = rep.get("engine")
+                if isinstance(eng, dict):
+                    value = eng.get("kv_page_headroom")
+                    if value is not None:
+                        values.append(value)
+            if values:
+                return min(values)
+        return None
+
+    return signal
+
+
+def _build_slo_engine(slo, slo_options, registry, stats_fn, telemetry_obj):
+    """Construct the SLOEngine (or None when ``slo`` is falsy).
+
+    ``slo`` is True (default specs) or a sequence of SLOSpec/spec dicts;
+    ``slo_options`` passes through engine kwargs (``clock``,
+    ``dump_blackbox``, extra ``signals`` — explicit signals win over the
+    built-in ``kv_headroom``/``welfare_drift`` closures)."""
+    if not slo:
+        return None
+    from consensus_tpu.obs.slo import SLOEngine
+
+    options = dict(slo_options or {})
+    specs = options.pop("specs", None)
+    if specs is None and slo is not True:
+        specs = slo
+    signals = dict(options.pop("signals", None) or {})
+    signals.setdefault("kv_headroom", _kv_headroom_signal(stats_fn))
+    if telemetry_obj is not None:
+        signals.setdefault("welfare_drift", telemetry_obj.drift_status)
+    return SLOEngine(
+        specs=specs, registry=registry, signals=signals, **options
+    )
 
 
 def _create_fleet_server(
@@ -204,6 +296,9 @@ def _create_fleet_server(
     engine_options,
     fleet_size,
     fleet_options,
+    telemetry_obj=None,
+    slo=False,
+    slo_options=None,
 ):
     """Build N replica stacks behind a :class:`FleetRouter`.
 
@@ -297,6 +392,7 @@ def _create_fleet_server(
                 "anytime_margin_s": anytime_margin_s,
                 "engine": engine_flag,
                 "engine_options": engine_options,
+                "telemetry": telemetry_obj,
             },
         )
 
@@ -338,4 +434,11 @@ def _create_fleet_server(
             autoscale_options.setdefault("max_replicas", fleet_size * 2)
             Autoscaler(manager, registry=registry, **autoscale_options)
 
-    return ConsensusServer(router, host=host, port=port, registry=registry)
+    slo_engine = _build_slo_engine(
+        slo, slo_options, registry, router.stats, telemetry_obj
+    )
+    return ConsensusServer(
+        router, host=host, port=port, registry=registry,
+        slo_engine=slo_engine, telemetry=telemetry_obj,
+        federate_metrics=telemetry_obj is not None,
+    )

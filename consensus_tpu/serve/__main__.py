@@ -110,6 +110,19 @@ def main(argv=None) -> int:
                              "(recent iterations + fleet events) to PATH on "
                              "watchdog trip, replica loss, or SIGTERM "
                              "(env: CONSENSUS_BLACKBOX)")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="welfare telemetry plane: latency + welfare "
+                             "quantile sketches (mergeable across replicas), "
+                             "per-tier degraded welfare-gap gauges, fairness "
+                             "drift detector; fleets federate /metrics")
+    parser.add_argument("--slo", action="store_true",
+                        help="run the multi-window burn-rate SLO engine "
+                             "(availability, p95 latency, degraded fraction, "
+                             "KV headroom, welfare drift) at GET /v1/slo "
+                             "and inside /healthz")
+    parser.add_argument("--slo-specs", default=None, metavar="JSON",
+                        help="JSON list of SLO spec dicts overriding the "
+                             "defaults (implies --slo)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -150,6 +163,8 @@ def main(argv=None) -> int:
         fleet_size=args.fleet,
         fleet_options=fleet_options or None,
         mesh=args.mesh,
+        telemetry=args.telemetry,
+        slo=(json.loads(args.slo_specs) if args.slo_specs else args.slo),
     )
     stop = threading.Event()
 
@@ -167,7 +182,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "serving": server.base_url,
         "endpoints": ["POST /v1/consensus", "GET /healthz", "GET /metrics",
-                      "GET /v1/trace/<request_id>"],
+                      "GET /v1/trace/<request_id>", "GET /v1/slo"],
         "backend": args.backend,
         "max_queue_depth": args.max_queue_depth,
         "max_inflight": args.max_inflight,

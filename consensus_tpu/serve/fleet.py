@@ -166,8 +166,11 @@ class Replica:
             brownout=brownout,
             **(scheduler_options or {}),
         )
-        # Spans and per-replica health report which replica served.
+        # Spans and per-replica health report which replica served; the
+        # tier label feeds welfare-by-tier telemetry (obs/welfare.py) so
+        # degraded-tier responses are accounted against full-tier welfare.
         self.scheduler.replica_name = name
+        self.scheduler.replica_tier = tier
         self._lost = threading.Event()
         self._lost_reason = ""
 

@@ -18,6 +18,16 @@ dependencies) in front of :class:`RequestScheduler`:
   liveness, device-batch accounting (the coalescing proof surface).
 * ``GET /metrics`` — Prometheus text exposition straight from the obs
   registry (the ``serve_*`` families plus everything the backends record).
+  With welfare telemetry on a fleet, the snapshot is federated first
+  (``obs/sketch.py``): per-replica sketches merge into exact
+  ``replica="fleet"`` series.
+* ``GET /v1/slo`` — burn rates, states, and the transition log from the
+  SLO engine (404 when the server was built without ``slo=True``); the
+  ``/healthz`` payload gains ``slo`` and ``welfare`` blocks when those
+  planes are armed.
+* ``GET /v1/trace/<request_id>`` — recent span trees; every response
+  (success or structured error) echoes a ``request_id`` so sketch
+  exemplars and error bodies alike are trace-addressable.
 
 Handler threads block on their ticket while the scheduler's worker pool —
 not the connection pool — bounds device work; a handler thread waiting on
@@ -32,6 +42,7 @@ import itertools
 import json
 import logging
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
@@ -91,10 +102,22 @@ class ConsensusHTTPServer(ThreadingHTTPServer):
         address: Tuple[str, int],
         scheduler: RequestScheduler,
         registry: Optional[Registry] = None,
+        slo_engine: Optional[Any] = None,
+        telemetry: Optional[Any] = None,
+        federate_metrics: bool = False,
     ):
         super().__init__(address, ConsensusRequestHandler)
         self.scheduler = scheduler
         self.registry = registry if registry is not None else get_registry()
+        #: Optional obs.slo.SLOEngine — fed one event per terminal HTTP
+        #: response, served at GET /v1/slo and in the /healthz slo block.
+        self.slo_engine = slo_engine
+        #: Optional obs.welfare.ServeTelemetry (for the /healthz welfare
+        #: block; the schedulers hold their own reference for recording).
+        self.telemetry = telemetry
+        #: Fleet mode: /metrics federates per-replica sketch/counter
+        #: series into additional replica="fleet" series (obs/sketch.py).
+        self.federate_metrics = federate_metrics
 
 
 class ConsensusRequestHandler(BaseHTTPRequestHandler):
@@ -107,8 +130,26 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._send_json(200, self._health_payload())
         elif self.path == "/metrics":
-            body = self.server.registry.to_prometheus().encode("utf-8")
-            self._send_bytes(200, body, "text/plain; version=0.0.4")
+            if self.server.federate_metrics:
+                from consensus_tpu.obs.metrics import prometheus_text
+                from consensus_tpu.obs.sketch import federate_snapshot
+
+                text = prometheus_text(
+                    federate_snapshot(self.server.registry.snapshot())
+                )
+            else:
+                text = self.server.registry.to_prometheus()
+            self._send_bytes(
+                200, text.encode("utf-8"), "text/plain; version=0.0.4"
+            )
+        elif self.path == "/v1/slo":
+            engine = self.server.slo_engine
+            if engine is None:
+                self._send_error_json(
+                    404, "slo_disabled",
+                    "no SLO engine attached (create_server(slo=True))")
+            else:
+                self._send_json(200, engine.evaluate())
         elif self.path.startswith("/v1/trace/"):
             trace_id = urllib.parse.unquote(self.path[len("/v1/trace/"):])
             trace = get_trace_store().get(trace_id)
@@ -137,10 +178,18 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         try:
             request = parse_request(payload)
         except RequestValidationError as exc:
+            # Even a rejected-at-the-door request gets a request id (the
+            # client's own, else a minted one): EVERY structured error
+            # response is trace-addressable.
+            supplied = (
+                str(payload.get("request_id") or "")
+                if isinstance(payload, dict) else ""
+            )
             self._send_json(400, {"error": {
                 "type": "validation",
                 "message": "request failed validation",
                 "details": exc.errors,
+                "request_id": supplied or _mint_request_id(payload),
             }})
             return
         if not request.request_id:
@@ -156,6 +205,8 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
         get_trace_store().put(trace)
         scheduler = self.server.scheduler
         status = 500
+        degraded = False
+        started = time.monotonic()
         try:
             try:
                 with use_trace(trace, root):
@@ -207,6 +258,8 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
                 }})
                 return
             status = 200
+            degraded = isinstance(result, dict) and bool(
+                result.get("degraded"))
             # End the root BEFORE snapshotting so the debug block's
             # critical path covers the full served latency.
             trace.end(root, status=200)
@@ -220,6 +273,15 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
             self._send_json(200, result)
         finally:
             trace.end(root, status=status)
+            engine = self.server.slo_engine
+            if engine is not None:
+                # One terminal event per response: 2xx (degraded or not)
+                # counts as served; 4xx/5xx past admission burns budget.
+                engine.record_request(
+                    ok=status == 200,
+                    latency_s=time.monotonic() - started,
+                    degraded=degraded,
+                )
 
     # -- helpers -----------------------------------------------------------
 
@@ -275,6 +337,13 @@ class ConsensusRequestHandler(BaseHTTPRequestHandler):
             "model": getattr(inner, "model_name", ""),
             "alive": stats["workers_alive"] > 0,
         }
+        engine = self.server.slo_engine
+        if engine is not None:
+            engine.evaluate()
+            stats["slo"] = engine.states()
+        telemetry = self.server.telemetry
+        if telemetry is not None:
+            stats["welfare"] = telemetry.snapshot()
         return stats
 
     def _read_json(self) -> Any:
@@ -328,9 +397,21 @@ class ConsensusServer:
         host: str = "127.0.0.1",
         port: int = 8080,
         registry: Optional[Registry] = None,
+        slo_engine: Optional[Any] = None,
+        telemetry: Optional[Any] = None,
+        federate_metrics: bool = False,
     ):
         self.scheduler = scheduler
-        self._httpd = ConsensusHTTPServer((host, port), scheduler, registry)
+        self.slo_engine = slo_engine
+        self.telemetry = telemetry
+        self._httpd = ConsensusHTTPServer(
+            (host, port),
+            scheduler,
+            registry,
+            slo_engine=slo_engine,
+            telemetry=telemetry,
+            federate_metrics=federate_metrics,
+        )
         self._thread: Optional[threading.Thread] = None
 
     @property

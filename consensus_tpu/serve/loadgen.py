@@ -144,6 +144,12 @@ class RequestOutcome:
     #: Launch offset from the run's start (seconds) — lets the report
     #: bucket outcomes into a recovery curve without re-deriving arrivals.
     started_s: float = 0.0
+    #: Welfare of the returned statement (``evaluate=True`` requests only;
+    #: cosine channel) — feeds the report's ``welfare`` block.
+    welfare_egalitarian: Optional[float] = None
+    welfare_utilitarian: Optional[float] = None
+    #: Worst-off agent's cosine utility — the egalitarian quantity itself.
+    min_agent_utility: Optional[float] = None
 
 
 def _percentile(sorted_values: List[float], q: float) -> float:
@@ -162,6 +168,7 @@ def run_loadgen(
     rate_rps: float,
     client_timeout_s: float = 60.0,
     curve_bucket_s: Optional[float] = None,
+    include_slo: bool = False,
 ) -> Dict[str, Any]:
     """Replay ``payloads`` open-loop at ``rate_rps`` against ``base_url``.
 
@@ -187,6 +194,21 @@ def run_loadgen(
                 request, timeout=client_timeout_s
             ) as response:
                 data = json.loads(response.read().decode("utf-8"))
+                welfare = data.get("welfare")
+                egal = util = min_util = None
+                if isinstance(welfare, dict):
+                    egal = welfare.get("egalitarian_welfare_cosine")
+                    util = welfare.get("utilitarian_welfare_cosine")
+                utilities = data.get("utilities")
+                if isinstance(utilities, dict) and utilities:
+                    per_agent = [
+                        u.get("cosine_similarity")
+                        for u in utilities.values()
+                        if isinstance(u, dict)
+                        and u.get("cosine_similarity") is not None
+                    ]
+                    if per_agent:
+                        min_util = min(per_agent)
                 outcomes[index] = RequestOutcome(
                     request_id=payload.get("request_id", str(index)),
                     status=response.status,
@@ -196,6 +218,9 @@ def run_loadgen(
                     served_by=str(data.get("served_by", "")),
                     served_tier=str(data.get("served_tier", "")),
                     started_s=started_s,
+                    welfare_egalitarian=egal,
+                    welfare_utilitarian=util,
+                    min_agent_utility=min_util,
                 )
         except urllib.error.HTTPError as exc:
             try:
@@ -293,6 +318,40 @@ def run_loadgen(
         )
     report["recovery_bucket_s"] = bucket_s
     report["recovery_curve"] = window.curve()
+    # Welfare block: only for evaluate=True payloads (the welfare fields
+    # ride on the response), summarising what fairness the run delivered —
+    # and, when some 200s were degraded, what egalitarian welfare the
+    # degradation cost relative to full-fidelity responses.
+    evaluated = [o for o in ok if o.welfare_egalitarian is not None]
+    if evaluated:
+        def _mean(values: List[float]) -> float:
+            return sum(values) / len(values)
+
+        egal = [o.welfare_egalitarian for o in evaluated]
+        util = [o.welfare_utilitarian for o in evaluated
+                if o.welfare_utilitarian is not None]
+        mins = sorted(o.min_agent_utility for o in evaluated
+                      if o.min_agent_utility is not None)
+        full_egal = [o.welfare_egalitarian for o in evaluated
+                     if not o.degraded]
+        degraded_egal = [o.welfare_egalitarian for o in evaluated
+                         if o.degraded]
+        report["welfare"] = {
+            "evaluated": len(evaluated),
+            "egalitarian_mean": round(_mean(egal), 6),
+            "utilitarian_mean": round(_mean(util), 6) if util else None,
+            "min_agent_utility_p5": (
+                round(_percentile(mins, 0.05), 6) if mins else None
+            ),
+            "degraded_welfare_gap": (
+                round(_mean(full_egal) - _mean(degraded_egal), 6)
+                if full_egal and degraded_egal else None
+            ),
+        }
+    if include_slo:
+        slo = fetch_slo(base_url)
+        if slo is not None:
+            report["slo"] = slo
     tier_counts = fetch_tier_counts(base_url)
     if tier_counts is not None:
         report["tier_request_counts"] = tier_counts
@@ -374,6 +433,33 @@ def run_loadgen(
             round(hits / (hits + misses), 4) if (hits + misses) else 0.0
         )
     return report
+
+
+def fetch_slo(base_url: str) -> Optional[Dict[str, Any]]:
+    """End-of-run SLO verdicts from the server's ``GET /v1/slo``: the
+    worst state plus per-SLO state and fast/slow burn rates.  None when
+    the server runs no SLO engine (404) or the endpoint is down."""
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/v1/slo", timeout=5.0
+        ) as response:
+            snapshot = json.loads(response.read().decode("utf-8"))
+    except Exception:
+        return None
+    if not isinstance(snapshot, dict) or "specs" not in snapshot:
+        return None
+    return {
+        "worst": snapshot.get("worst"),
+        "specs": {
+            spec["name"]: {
+                "state": spec.get("state"),
+                "fast_burn": (spec.get("burn") or {}).get("fast"),
+                "slow_burn": (spec.get("burn") or {}).get("slow"),
+            }
+            for spec in snapshot.get("specs", [])
+            if isinstance(spec, dict) and "name" in spec
+        },
+    }
 
 
 def fetch_fleet_stats(base_url: str) -> Optional[Dict[str, Any]]:

@@ -301,6 +301,7 @@ class FleetRouter:
         )
 
         reg = registry if registry is not None else get_registry()
+        self.registry = reg
         self._m_healthy = reg.gauge(
             "fleet_replicas_healthy",
             "Replicas currently routable at full preference.")
@@ -894,3 +895,13 @@ class FleetRouter:
         if self.autoscaler is not None:
             stats["fleet"]["autoscaler"] = self.autoscaler.snapshot()
         return stats
+
+    def federated_metrics_snapshot(self) -> Dict[str, Any]:
+        """Fleet-federated registry snapshot: replica-labelled sketch and
+        counter series are merged into synthetic ``replica="fleet"``
+        series alongside the per-replica ones.  Sketch merges are exact —
+        the fleet p99 equals the sketch of the pooled observations
+        (tests/test_welfare_telemetry.py pins the 3-replica equivalence)."""
+        from consensus_tpu.obs.sketch import federate_snapshot
+
+        return federate_snapshot(self.registry.snapshot())

@@ -167,6 +167,7 @@ class RequestScheduler:
         anytime_margin_s: float = 0.2,
         engine: bool = True,
         engine_options: Optional[Dict[str, Any]] = None,
+        telemetry: Optional[Any] = None,
     ):
         if max_queue_depth < 1 or max_inflight < 1:
             raise ValueError("max_queue_depth and max_inflight must be >= 1")
@@ -251,6 +252,13 @@ class RequestScheduler:
         #: Stamped by the fleet's Replica wrapper so spans and health report
         #: which replica served; empty for a standalone scheduler.
         self.replica_name = ""
+        #: Fleet tier of the owning replica ("full" / "degraded"); feeds the
+        #: welfare-by-tier accounting when telemetry is on.
+        self.replica_tier = ""
+        #: Optional :class:`~consensus_tpu.obs.welfare.ServeTelemetry`.
+        #: None (the default) keeps the hot path byte-identical: the only
+        #: cost is one attribute check per terminal request.
+        self.telemetry = telemetry
 
         self._lock = threading.Lock()
         self._work_cv = threading.Condition(self._lock)
@@ -589,4 +597,28 @@ class RequestScheduler:
             ticket.trace.end(ticket._span_queue)
             ticket.trace.end(ticket._span_handler, outcome=outcome,
                              attempts=ticket.attempts)
+        if self.telemetry is not None:
+            # Degraded-tier attribution: a non-full fleet tier wins, else
+            # the live brownout tier; "" lets telemetry fall back to the
+            # response's degraded_reason.
+            tier = ""
+            if self.replica_tier and self.replica_tier != "full":
+                tier = self.replica_tier
+            elif self.brownout is not None and self.brownout.tier:
+                tier = f"brownout{self.brownout.tier}"
+            self.telemetry.record_request(
+                method=method,
+                outcome=outcome,
+                latency_s=elapsed,
+                value=value,
+                replica=self.replica_name,
+                tier=tier,
+                # Exemplar linkage: the request id doubles as the trace id
+                # when the request carried a trace (GET /v1/trace/<id>).
+                trace_id=(
+                    getattr(ticket.request, "request_id", None)
+                    if ticket.trace is not None
+                    else None
+                ),
+            )
         ticket._finish(outcome, value=value, error=error)

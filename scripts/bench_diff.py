@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Diff two BENCH_rNN.json snapshots key-by-key with regression gates.
+
+The bench snapshots (``BENCH_r01.json`` .. in the repo root) record the
+tail JSON of a full ``bench.py`` run: one headline metric plus a flat
+``extra`` dict of per-cell numbers.  This script flattens both files to
+dotted numeric keys, compares them, and applies per-metric regression
+thresholds — direction-aware (throughput regressing means DOWN, latency
+regressing means UP), with generous bounds because the committed
+snapshots come from 1-trial CPU smoke runs.
+
+Exit status is nonzero when any gated metric regressed beyond its
+threshold (or a gated metric present in the old snapshot vanished from
+the new one — an env-gated cell silently breaking looks exactly like
+that).  Ungated keys are reported informationally and never fail.
+
+Usage:
+
+    python scripts/bench_diff.py BENCH_r08.json BENCH_r09.json
+    python scripts/bench_diff.py --latest-pair        # two newest by n
+    python scripts/bench_diff.py --latest-pair --max-regression 0.75
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import pathlib
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: Gated metrics: (key glob, direction, max adverse relative change).
+#: Direction "higher" = bigger is better (throughput), "lower" = smaller
+#: is better (latency).  First match wins.  Bounds are wide on purpose —
+#: the snapshots are single-trial CPU smoke runs, and the gate exists to
+#: catch order-of-magnitude cell breakage, not 5% jitter.
+DEFAULT_GATES: List[Tuple[str, str, float]] = [
+    ("value", "higher", 0.5),
+    ("extra.tokens_per_sec", "higher", 0.5),
+    ("extra.engine_statements_per_sec", "higher", 0.5),
+    ("extra.engine_vs_legacy_throughput", "higher", 0.4),
+    ("extra.engine_k8_statements_per_sec", "higher", 0.5),
+    ("extra.bon_latency_seconds_per_statement", "lower", 1.0),
+    ("extra.beam_search_seconds_per_statement", "lower", 1.0),
+    ("extra.finite_lookahead_seconds_per_statement", "lower", 1.0),
+    ("extra.serve_throughput_rps", "higher", 0.5),
+    ("extra.serve_p99_ms", "lower", 1.5),
+    ("extra.chaos_success_frac", "higher", 0.15),
+    ("extra.brownout_availability", "higher", 0.15),
+    ("extra.fleet_availability", "higher", 0.15),
+    ("extra.padding_efficiency", "higher", 0.3),
+    ("extra.engine_padding_efficiency", "higher", 0.3),
+    ("extra.bench_obs.throughput_on_rps", "higher", 0.5),
+]
+
+
+def flatten(value: Any, prefix: str = "") -> Dict[str, float]:
+    """Dotted numeric leaves of a nested dict (bools excluded)."""
+    out: Dict[str, float] = {}
+    if isinstance(value, dict):
+        for key, sub in value.items():
+            path = f"{prefix}.{key}" if prefix else str(key)
+            out.update(flatten(sub, path))
+    elif isinstance(value, (int, float)) and not isinstance(value, bool):
+        out[prefix] = float(value)
+    return out
+
+
+def load_snapshot(path: pathlib.Path) -> Dict[str, float]:
+    """BENCH_rNN.json -> flat metric dict (from the run's tail JSON)."""
+    snap = json.loads(path.read_text())
+    if snap.get("rc", 0) != 0:
+        raise SystemExit(f"{path.name}: bench run recorded rc={snap['rc']}")
+    tail = snap.get("tail", "")
+    # The tail is the last stdout line(s); the metric record is the last
+    # parseable JSON object line.
+    record = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+    if not isinstance(record, dict):
+        raise SystemExit(f"{path.name}: no JSON metric record in tail")
+    return flatten(record)
+
+
+def gate_for(key: str, gates: List[Tuple[str, str, float]]):
+    for pattern, direction, bound in gates:
+        if fnmatch.fnmatch(key, pattern):
+            return direction, bound
+    return None
+
+
+def adverse_change(
+    old: float, new: float, direction: str
+) -> Optional[float]:
+    """Relative change in the BAD direction (None when not adverse)."""
+    if old == 0:
+        return None  # no baseline to regress against
+    rel = (new - old) / abs(old)
+    if direction == "higher" and rel < 0:
+        return -rel
+    if direction == "lower" and rel > 0:
+        return rel
+    return None
+
+
+def latest_pair() -> Tuple[pathlib.Path, pathlib.Path]:
+    snaps = sorted(
+        REPO_ROOT.glob("BENCH_r*.json"),
+        key=lambda p: json.loads(p.read_text()).get("n", 0),
+    )
+    if len(snaps) < 2:
+        raise SystemExit("need at least two BENCH_r*.json snapshots")
+    return snaps[-2], snaps[-1]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("old", nargs="?", help="older BENCH_rNN.json")
+    parser.add_argument("new", nargs="?", help="newer BENCH_rNN.json")
+    parser.add_argument("--latest-pair", action="store_true",
+                        help="diff the two newest snapshots in the repo "
+                             "root (by their recorded n)")
+    parser.add_argument("--max-regression", type=float, default=None,
+                        help="override every gate's threshold with one "
+                             "adverse relative bound (e.g. 0.75)")
+    parser.add_argument("--gates-json", default=None,
+                        help="JSON list of [key_glob, direction, bound] "
+                             "triples replacing the built-in gate table")
+    args = parser.parse_args(argv)
+
+    if args.latest_pair:
+        old_path, new_path = latest_pair()
+    elif args.old and args.new:
+        old_path, new_path = pathlib.Path(args.old), pathlib.Path(args.new)
+    else:
+        parser.error("give OLD NEW paths or --latest-pair")
+
+    gates = DEFAULT_GATES
+    if args.gates_json:
+        gates = [tuple(g) for g in json.loads(args.gates_json)]
+    if args.max_regression is not None:
+        gates = [(p, d, args.max_regression) for p, d, _ in gates]
+
+    old = load_snapshot(old_path)
+    new = load_snapshot(new_path)
+
+    regressions: List[str] = []
+    rows: List[str] = []
+    for key in sorted(set(old) | set(new)):
+        gate = gate_for(key, gates)
+        o, n = old.get(key), new.get(key)
+        if o is None:
+            rows.append(f"  NEW       {key} = {n}")
+            continue
+        if n is None:
+            if gate is not None:
+                regressions.append(f"{key}: present in {old_path.name} "
+                                   f"but missing from {new_path.name}")
+                rows.append(f"  MISSING!  {key} (was {o})")
+            else:
+                rows.append(f"  dropped   {key} (was {o})")
+            continue
+        if gate is None:
+            if o != n:
+                rows.append(f"  info      {key}: {o} -> {n}")
+            continue
+        direction, bound = gate
+        adverse = adverse_change(o, n, direction)
+        if adverse is not None and adverse > bound:
+            regressions.append(
+                f"{key}: {o} -> {n} ({direction} is better; adverse "
+                f"{adverse:.1%} > {bound:.0%} threshold)"
+            )
+            rows.append(f"  REGRESS!  {key}: {o} -> {n} (-{adverse:.1%})")
+        else:
+            delta = "" if o == n else f" ({(n - o) / abs(o):+.1%})" \
+                if o else ""
+            rows.append(f"  ok        {key}: {o} -> {n}{delta}")
+
+    print(f"bench diff: {old_path.name} -> {new_path.name}")
+    for row in rows:
+        print(row)
+    if regressions:
+        print(f"\n{len(regressions)} regression(s):", file=sys.stderr)
+        for item in regressions:
+            print(f"  {item}", file=sys.stderr)
+        return 1
+    print("\nno gated regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

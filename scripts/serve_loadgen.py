@@ -207,6 +207,15 @@ def main(argv=None) -> int:
     parser.add_argument("--kill-replica", default="r0", metavar="NAME",
                         help="(self-contained, fleet) which replica "
                              "--kill-replica-at-s kills (default: r0)")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="(self-contained) enable the welfare "
+                             "telemetry plane (latency + welfare quantile "
+                             "sketches, drift detector) on the server")
+    parser.add_argument("--slo", action="store_true",
+                        help="run the server's burn-rate SLO engine "
+                             "(self-contained implies creating it; --url "
+                             "mode just reads GET /v1/slo) and print the "
+                             "end-of-run SLO verdicts in the report")
     parser.add_argument("--fault-plan", default=None,
                         help="(self-contained) JSON fault plan injected "
                              "below a supervised backend, e.g. "
@@ -267,6 +276,8 @@ def main(argv=None) -> int:
             fleet_size=args.fleet,
             fleet_options=fleet_options or None,
             mesh=args.mesh,
+            telemetry=args.telemetry,
+            slo=args.slo,
         ).start()
         schedule = (_parse_chaos_schedule(args.chaos_schedule)
                     if args.chaos_schedule else [])
@@ -297,6 +308,7 @@ def main(argv=None) -> int:
             report = run_loadgen(
                 server.base_url, payloads, args.rate,
                 client_timeout_s=args.client_timeout_s,
+                include_slo=args.slo,
             )
             report["device_batches"] = server.scheduler.stats()[
                 "device_batches"]
@@ -343,6 +355,7 @@ def main(argv=None) -> int:
         report = run_loadgen(
             args.url, payloads, args.rate,
             client_timeout_s=args.client_timeout_s,
+            include_slo=args.slo,
         )
 
     print(report_json(report))

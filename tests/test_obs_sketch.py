@@ -1,0 +1,361 @@
+"""Mergeable quantile sketch algebra (ISSUE 16 tentpole layer 1).
+
+The property the federated /metrics view rests on: a sketch is integer
+bucket counts, so merge is key-wise addition — associative, commutative,
+and lossless.  Pinned here:
+
+* **Relative-error bound**: every quantile estimate is within
+  ``relative_accuracy`` of the exact order statistic
+  ``sorted(values)[floor(q*(n-1))]``, across magnitudes, signs, and
+  accuracies.
+* **Merge algebra**: associativity ``(a+b)+c == a+(b+c)`` and
+  commutativity ``a+b == b+a`` as full store equality (dyadic-rational
+  inputs keep the float ``sum`` exact too), and merge == pooled: merging
+  N sketches equals one sketch fed the concatenated stream.
+* **Edges**: empty sketches, zero/near-zero collapse, NaN dropped,
+  single-value, huge-magnitude saturation.
+* **Exemplars**: bounded retention from the configured extreme tail,
+  surviving merge.
+* **Snapshot algebra**: ``diff_sketch_series`` is exact store
+  subtraction (None when idle); ``federate_snapshot`` adds an exact
+  ``replica="fleet"`` merge per family, sums counters, and skips gauges.
+"""
+
+import math
+import random
+
+import pytest
+
+from consensus_tpu.obs.metrics import Registry
+from consensus_tpu.obs.sketch import (
+    DEFAULT_MAX_EXEMPLARS,
+    MIN_TRACKABLE,
+    QuantileSketch,
+    diff_sketch_series,
+    federate_snapshot,
+    merge_sketch_series,
+    quantile_from_series,
+)
+
+
+def exact_quantile(values, q):
+    ordered = sorted(values)
+    return ordered[int(math.floor(q * (len(ordered) - 1)))]
+
+
+def assert_within_relative(estimate, exact, alpha):
+    assert estimate is not None
+    assert abs(estimate - exact) <= alpha * abs(exact) + MIN_TRACKABLE, (
+        f"estimate {estimate} vs exact {exact} exceeds alpha={alpha}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Relative-error bound
+# ---------------------------------------------------------------------------
+
+
+class TestRelativeErrorBound:
+    @pytest.mark.parametrize("alpha", [0.01, 0.05])
+    def test_lognormal_positive_stream(self, alpha):
+        rng = random.Random(7)
+        values = [math.exp(rng.gauss(0.0, 2.0)) for _ in range(2000)]
+        sketch = QuantileSketch(relative_accuracy=alpha)
+        for v in values:
+            sketch.observe(v)
+        for q in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99):
+            assert_within_relative(
+                sketch.quantile(q), exact_quantile(values, q), alpha
+            )
+
+    def test_signed_welfare_like_stream(self):
+        # Welfare values: signed, clustered near zero, negative log-Nash
+        # tail — the regime the three-store design exists for.
+        rng = random.Random(11)
+        values = [rng.uniform(-1.0, 1.0) for _ in range(500)]
+        values += [-math.exp(rng.gauss(1.0, 1.0)) for _ in range(500)]
+        sketch = QuantileSketch(relative_accuracy=0.01, extreme="low")
+        for v in values:
+            sketch.observe(v)
+        for q in (0.05, 0.1, 0.5, 0.9, 0.95):
+            assert_within_relative(
+                sketch.quantile(q), exact_quantile(values, q), 0.01
+            )
+
+    def test_magnitudes_across_decades(self):
+        values = [10.0 ** e for e in range(-9, 10)]
+        sketch = QuantileSketch(relative_accuracy=0.01)
+        for v in values:
+            sketch.observe(v)
+        for q in (0.1, 0.5, 0.9):
+            assert_within_relative(
+                sketch.quantile(q), exact_quantile(values, q), 0.01
+            )
+
+    def test_q0_and_q1_are_exact_min_max(self):
+        sketch = QuantileSketch()
+        for v in (3.7, -2.2, 9.9, 0.0):
+            sketch.observe(v)
+        assert sketch.quantile(0.0) == -2.2
+        assert sketch.quantile(1.0) == 9.9
+
+    def test_count_sum_track_observations(self):
+        sketch = QuantileSketch()
+        for v in (1.0, 2.0, 3.5):
+            sketch.observe(v)
+        assert sketch.count == 3
+        assert sketch.sum == 6.5
+
+
+# ---------------------------------------------------------------------------
+# Merge algebra
+# ---------------------------------------------------------------------------
+
+
+def _dyadic_stream(seed, n):
+    # Dyadic rationals with a narrow exponent range: float addition over
+    # them is exact, so store equality can include `sum`.
+    rng = random.Random(seed)
+    return [rng.randrange(-1024, 1025) / 64.0 for _ in range(n)]
+
+
+def _sketch_of(values, **kwargs):
+    sketch = QuantileSketch(**kwargs)
+    for v in values:
+        sketch.observe(v)
+    return sketch
+
+
+class TestMergeAlgebra:
+    def test_merge_equals_pooled_stream(self):
+        streams = [_dyadic_stream(s, 300) for s in (1, 2, 3)]
+        merged = _sketch_of(streams[0])
+        merged.merge(_sketch_of(streams[1]))
+        merged.merge(_sketch_of(streams[2]))
+        pooled = _sketch_of([v for s in streams for v in s])
+        assert merged.series_view() == pooled.series_view()
+        for q in (0.05, 0.5, 0.95, 0.99):
+            assert merged.quantile(q) == pooled.quantile(q)
+
+    def test_associativity(self):
+        a1, b1, c1 = (_sketch_of(_dyadic_stream(s, 200)) for s in (4, 5, 6))
+        a2, b2, c2 = (_sketch_of(_dyadic_stream(s, 200)) for s in (4, 5, 6))
+        left = a1.merge(b1).merge(c1)  # (a+b)+c
+        right = a2.merge(b2.merge(c2))  # a+(b+c)
+        assert left.series_view() == right.series_view()
+
+    def test_commutativity(self):
+        a1, b1 = _sketch_of(_dyadic_stream(7, 200)), _sketch_of(
+            _dyadic_stream(8, 200))
+        a2, b2 = _sketch_of(_dyadic_stream(7, 200)), _sketch_of(
+            _dyadic_stream(8, 200))
+        assert a1.merge(b1).series_view() == b2.merge(a2).series_view()
+
+    def test_merge_rejects_mismatched_accuracy(self):
+        with pytest.raises(ValueError, match="relative accuracy"):
+            QuantileSketch(relative_accuracy=0.01).merge(
+                QuantileSketch(relative_accuracy=0.02))
+
+    def test_merge_with_empty_is_identity(self):
+        full = _sketch_of(_dyadic_stream(9, 100))
+        before = full.series_view()
+        full.merge(QuantileSketch())
+        assert full.series_view() == before
+        empty = QuantileSketch()
+        empty.merge(_sketch_of(_dyadic_stream(9, 100)))
+        assert empty.series_view() == before
+
+
+# ---------------------------------------------------------------------------
+# Edges
+# ---------------------------------------------------------------------------
+
+
+class TestEdges:
+    def test_empty_sketch(self):
+        sketch = QuantileSketch()
+        assert sketch.quantile(0.5) is None
+        assert sketch.count == 0
+        view = sketch.series_view()
+        assert view["count"] == 0 and view["min"] is None
+
+    def test_zero_and_subtrackable_collapse(self):
+        sketch = QuantileSketch()
+        for v in (0.0, 1e-15, -1e-15):
+            sketch.observe(v)
+        view = sketch.series_view()
+        assert view["zero"] == 3 and not view["pos"] and not view["neg"]
+        assert sketch.quantile(0.5) == 0.0
+
+    def test_nan_dropped(self):
+        sketch = QuantileSketch()
+        sketch.observe(float("nan"))
+        assert sketch.count == 0
+
+    def test_single_value(self):
+        sketch = QuantileSketch()
+        sketch.observe(42.0)
+        for q in (0.0, 0.5, 1.0):
+            assert_within_relative(sketch.quantile(q), 42.0, 0.01)
+
+    def test_huge_magnitude_saturation(self):
+        sketch = QuantileSketch()
+        for v in (1e300, 2e300, 1.0):
+            sketch.observe(v)
+        assert_within_relative(sketch.quantile(0.99), 1e300, 0.01)
+        assert sketch.quantile(1.0) == 2e300
+        assert sketch.quantile(0.0) == 1.0
+
+    def test_invalid_quantile_and_accuracy(self):
+        with pytest.raises(ValueError):
+            QuantileSketch().quantile(1.5)
+        with pytest.raises(ValueError):
+            QuantileSketch(relative_accuracy=0.0)
+        with pytest.raises(ValueError):
+            QuantileSketch(extreme="sideways")
+
+
+# ---------------------------------------------------------------------------
+# Exemplars
+# ---------------------------------------------------------------------------
+
+
+class TestExemplars:
+    def test_high_extreme_keeps_slowest(self):
+        sketch = QuantileSketch(extreme="high")
+        for i in range(20):
+            sketch.observe(float(i), trace_id=f"req-{i}")
+        view = sketch.series_view()
+        kept = {e["value"] for e in view["exemplars"]}
+        assert len(kept) == DEFAULT_MAX_EXEMPLARS
+        assert kept == set(float(i) for i in range(12, 20))
+
+    def test_low_extreme_keeps_most_unfair(self):
+        sketch = QuantileSketch(extreme="low", max_exemplars=3)
+        for v in (0.5, -0.9, 0.1, -0.2, 0.8):
+            sketch.observe(v, trace_id=f"t{v}")
+        kept = {e["value"] for e in sketch.series_view()["exemplars"]}
+        assert kept == {-0.9, -0.2, 0.1}
+
+    def test_untraced_observations_leave_no_exemplar(self):
+        sketch = QuantileSketch()
+        sketch.observe(1.0)
+        assert sketch.series_view()["exemplars"] == []
+
+    def test_exemplars_survive_merge(self):
+        a = QuantileSketch(extreme="high")
+        b = QuantileSketch(extreme="high")
+        a.observe(10.0, trace_id="slow-a")
+        b.observe(99.0, trace_id="slow-b")
+        a.merge(b)
+        ids = {e["trace_id"] for e in a.series_view()["exemplars"]}
+        assert ids == {"slow-a", "slow-b"}
+
+
+# ---------------------------------------------------------------------------
+# Snapshot-series algebra (diff / merge / quantile on plain dicts)
+# ---------------------------------------------------------------------------
+
+
+class TestSeriesAlgebra:
+    def test_diff_is_exact_store_subtraction(self):
+        sketch = _sketch_of(_dyadic_stream(10, 50))
+        before = sketch.series_view()
+        extra = _dyadic_stream(11, 25)
+        for v in extra:
+            sketch.observe(v)
+        delta = diff_sketch_series(before, sketch.series_view())
+        assert delta["count"] == 25
+        only_extra = _sketch_of(extra).series_view()
+        assert delta["pos"] == only_extra["pos"]
+        assert delta["neg"] == only_extra["neg"]
+        assert delta["zero"] == only_extra["zero"]
+
+    def test_diff_idle_series_is_none(self):
+        view = _sketch_of([1.0, 2.0]).series_view()
+        assert diff_sketch_series(view, view) is None
+        assert diff_sketch_series(None, QuantileSketch().series_view()) is None
+
+    def test_series_merge_matches_sketch_merge(self):
+        a, b = _dyadic_stream(12, 80), _dyadic_stream(13, 80)
+        target = dict(_sketch_of(a).series_view())
+        merge_sketch_series(target, _sketch_of(b).series_view())
+        pooled = _sketch_of(a + b).series_view()
+        for key in ("count", "sum", "min", "max", "zero", "pos", "neg"):
+            assert target[key] == pooled[key]
+        assert quantile_from_series(target, 0.95) == quantile_from_series(
+            pooled, 0.95)
+
+    def test_from_dict_round_trip(self):
+        sketch = _sketch_of(_dyadic_stream(14, 60), relative_accuracy=0.05,
+                            extreme="low")
+        clone = QuantileSketch.from_dict(sketch.to_dict())
+        assert clone.series_view() == sketch.series_view()
+        assert clone.relative_accuracy == 0.05
+        assert clone.quantile(0.9) == sketch.quantile(0.9)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot federation
+# ---------------------------------------------------------------------------
+
+
+def _federation_registry():
+    registry = Registry()
+    latency = registry.sketch(
+        "lat", "latency", labels=("replica", "outcome"))
+    requests = registry.counter(
+        "reqs_total", "requests", labels=("replica",))
+    occupancy = registry.gauge("occ", "occupancy", labels=("replica",))
+    streams = {
+        "r0": _dyadic_stream(20, 100),
+        "r1": _dyadic_stream(21, 150),
+        "r2": _dyadic_stream(22, 50),
+    }
+    for name, values in streams.items():
+        for v in values:
+            latency.labels(name, "ok").observe(abs(v))
+        requests.labels(name).inc(len(values))
+        occupancy.labels(name).set(0.5)
+    return registry, streams
+
+
+class TestFederation:
+    def test_fleet_p99_equals_pooled_p99_exactly(self):
+        registry, streams = _federation_registry()
+        fed = federate_snapshot(registry.snapshot())
+        family = fed["families"]["lat"]
+        fleet = [s for s in family["series"]
+                 if s["labels"]["replica"] == "fleet"]
+        assert len(fleet) == 1
+        pooled = QuantileSketch()
+        for values in streams.values():
+            for v in values:
+                pooled.observe(abs(v))
+        body = {k: v for k, v in fleet[0].items() if k != "labels"}
+        assert body["pos"] == pooled.series_view()["pos"]
+        for q in (0.5, 0.9, 0.99):
+            assert quantile_from_series(body, q) == pooled.quantile(q)
+
+    def test_per_replica_series_preserved(self):
+        registry, streams = _federation_registry()
+        fed = federate_snapshot(registry.snapshot())
+        replicas = {s["labels"]["replica"]
+                    for s in fed["families"]["lat"]["series"]}
+        assert replicas == {"r0", "r1", "r2", "fleet"}
+
+    def test_counters_sum_and_gauges_skipped(self):
+        registry, streams = _federation_registry()
+        fed = federate_snapshot(registry.snapshot())
+        counter = fed["families"]["reqs_total"]["series"]
+        fleet = [s for s in counter if s["labels"]["replica"] == "fleet"]
+        assert fleet[0]["value"] == sum(len(v) for v in streams.values())
+        gauge_labels = {s["labels"]["replica"]
+                        for s in fed["families"]["occ"]["series"]}
+        assert "fleet" not in gauge_labels
+
+    def test_idempotent_on_already_federated_snapshot(self):
+        registry, _ = _federation_registry()
+        once = federate_snapshot(registry.snapshot())
+        twice = federate_snapshot(once)
+        assert twice == once

@@ -1,0 +1,427 @@
+"""Burn-rate SLO engine on a fake clock (ISSUE 16 tentpole layer 3).
+
+Everything here is deterministic: the engine takes an injectable clock,
+so the multi-window state machine is driven second-by-second with no
+sleeps.  Pinned:
+
+* **Spec validation**: ``SLOSpec.from_dict`` rejects unknown fields,
+  out-of-range objectives, inverted windows, unknown signals; the engine
+  rejects duplicate spec names.
+* **Burn-rate math**: burn = (bad/total) / (1 - objective), exactly.
+* **State machine**: the full ok -> burning -> violated walk under an
+  injected fault (single-step — never ok -> violated in one evaluate),
+  the blackbox dump ``slo_violated:<name>`` fired exactly once on the
+  violated edge, and the recovery walk violated -> burning -> ok as the
+  windows drain.
+* **Poll signals**: ``kv_headroom`` floats classified against the spec
+  threshold, ``welfare_drift`` status mappings and bare bools, ``None``
+  and raising callables skipped without poisoning the window.
+* **Windows**: one-second bucket aggregation and horizon pruning in
+  ``_EventWindow``.
+* **Registry surfaces**: ``slo_burn_rate``, ``slo_state`` and
+  ``slo_transitions_total`` reflect the machine.
+"""
+
+import pytest
+
+from consensus_tpu.obs.metrics import Registry
+from consensus_tpu.obs.slo import (
+    DEFAULT_SLO_SPECS,
+    SLOEngine,
+    SLOSpec,
+    _EventWindow,
+)
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.t = start
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+AVAIL = SLOSpec(
+    name="availability",
+    signal="availability",
+    objective=0.99,
+    fast_window_s=60.0,
+    slow_window_s=600.0,
+    fast_burn_threshold=10.0,
+    slow_burn_threshold=2.0,
+)
+
+
+def _engine(specs, registry=None, dumps=None, signals=None):
+    clock = FakeClock()
+    engine = SLOEngine(
+        specs=specs,
+        registry=registry,
+        clock=clock,
+        dump_blackbox=(dumps.append if dumps is not None else lambda r: None),
+        signals=signals,
+    )
+    return engine, clock
+
+
+def _spec_state(snapshot, name):
+    return next(s for s in snapshot["specs"] if s["name"] == name)
+
+
+def _gauge_value(registry, family, *label_values):
+    fam = registry.snapshot()["families"][family]
+    for series in fam["series"]:
+        if tuple(series["labels"].values()) == label_values:
+            return series["value"]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Spec validation
+# ---------------------------------------------------------------------------
+
+
+class TestSpecValidation:
+    def test_from_dict_round_trip(self):
+        spec = SLOSpec.from_dict(AVAIL.to_dict())
+        assert spec == AVAIL
+
+    def test_from_dict_rejects_unknown_fields(self):
+        with pytest.raises(ValueError, match="unknown SLO spec fields"):
+            SLOSpec.from_dict({"name": "x", "signal": "latency",
+                               "burn_limit": 3})
+
+    def test_rejects_unknown_signal(self):
+        with pytest.raises(ValueError, match="unknown SLO signal"):
+            SLOSpec(name="x", signal="vibes")
+
+    def test_rejects_objective_out_of_range(self):
+        for bad in (0.0, 1.0, -0.5, 2.0):
+            with pytest.raises(ValueError, match="objective"):
+                SLOSpec(name="x", signal="availability", objective=bad)
+
+    def test_rejects_inverted_windows(self):
+        with pytest.raises(ValueError, match="fast_window_s"):
+            SLOSpec(name="x", signal="availability",
+                    fast_window_s=600.0, slow_window_s=60.0)
+
+    def test_engine_rejects_duplicate_names(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEngine(specs=[AVAIL, AVAIL])
+
+    def test_engine_accepts_dict_specs(self):
+        engine = SLOEngine(specs=[{"name": "lat", "signal": "latency",
+                                   "objective": 0.95, "threshold": 2.0}])
+        assert engine.specs[0].threshold == 2.0
+
+    def test_default_specs_cover_all_signals(self):
+        signals = {spec.signal for spec in DEFAULT_SLO_SPECS}
+        assert signals == {"availability", "latency", "degraded",
+                          "kv_headroom", "welfare_drift"}
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate math
+# ---------------------------------------------------------------------------
+
+
+class TestBurnRate:
+    def test_burn_is_bad_fraction_over_budget(self):
+        engine, clock = _engine([AVAIL])
+        for i in range(10):
+            engine.record_request(ok=(i != 0), now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        burn = _spec_state(snap, "availability")["burn"]
+        # 1 bad of 10, budget 0.01 -> burn exactly 10.0 in both windows.
+        assert burn["fast"] == pytest.approx(10.0)
+        assert burn["slow"] == pytest.approx(10.0)
+
+    def test_no_events_is_zero_burn_ok(self):
+        engine, clock = _engine([AVAIL])
+        snap = engine.evaluate(now=clock.t)
+        spec = _spec_state(snap, "availability")
+        assert spec["burn"] == {"fast": 0.0, "slow": 0.0}
+        assert spec["state"] == "ok"
+
+    def test_latency_signal_thresholds_and_ignores_missing(self):
+        spec = SLOSpec(name="lat", signal="latency", objective=0.5,
+                       threshold=2.0)
+        engine, clock = _engine([spec])
+        engine.record_request(ok=True, latency_s=5.0, now=clock.t)   # bad
+        engine.record_request(ok=True, latency_s=0.1, now=clock.t)   # good
+        engine.record_request(ok=False, latency_s=None, now=clock.t)  # skip
+        snap = engine.evaluate(now=clock.t)
+        windows = _spec_state(snap, "lat")["windows"]
+        assert windows["fast"] == {"good": 1, "bad": 1, "total": 2}
+
+    def test_degraded_signal(self):
+        spec = SLOSpec(name="deg", signal="degraded", objective=0.8)
+        engine, clock = _engine([spec])
+        engine.record_request(ok=True, degraded=True, now=clock.t)
+        engine.record_request(ok=True, degraded=False, now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        assert _spec_state(snap, "deg")["windows"]["fast"]["bad"] == 1
+
+
+# ---------------------------------------------------------------------------
+# The state machine on a fake clock
+# ---------------------------------------------------------------------------
+
+
+class TestStateMachine:
+    def _inject_fault(self, engine, clock, bad=8, good=12):
+        for i in range(bad + good):
+            engine.record_request(ok=(i >= bad), now=clock.t)
+
+    def test_full_walk_fault_then_recovery(self):
+        registry = Registry()
+        dumps = []
+        engine, clock = _engine([AVAIL], registry=registry, dumps=dumps)
+
+        # Healthy baseline.
+        for _ in range(20):
+            engine.record_request(ok=True, now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        assert snap["worst"] == "ok"
+        assert dumps == []
+
+        # Latency-fault burst: 8 bad / 20 -> fast burn 40 >> 10.
+        clock.advance(5.0)
+        self._inject_fault(engine, clock)
+        snap = engine.evaluate(now=clock.t)
+        # Single-step: first evaluate only reaches burning.
+        assert _spec_state(snap, "availability")["state"] == "burning"
+        assert dumps == []
+
+        # Same events still hot in BOTH windows -> violated, blackbox.
+        clock.advance(1.0)
+        snap = engine.evaluate(now=clock.t)
+        assert _spec_state(snap, "availability")["state"] == "violated"
+        assert snap["worst"] == "violated"
+        assert dumps == ["slo_violated:availability"]
+
+        # Fast window drains (bad burst ages out of 60s) -> burning.
+        clock.advance(120.0)
+        for _ in range(30):
+            engine.record_request(ok=True, now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        assert _spec_state(snap, "availability")["state"] == "burning"
+
+        # Slow window drains too -> ok.  One dump total.
+        clock.advance(700.0)
+        for _ in range(30):
+            engine.record_request(ok=True, now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        assert _spec_state(snap, "availability")["state"] == "ok"
+        assert snap["worst"] == "ok"
+        assert dumps == ["slo_violated:availability"]
+
+        # The walk is in the transition log, in order.
+        walk = [(t["from"], t["to"]) for t in snap["transitions"]]
+        assert walk == [("ok", "burning"), ("burning", "violated"),
+                        ("violated", "burning"), ("burning", "ok")]
+
+        # And mirrored in the registry.
+        assert _gauge_value(registry, "slo_state", "availability") == 0
+        assert _gauge_value(
+            registry, "slo_transitions_total", "availability", "violated"
+        ) == 1
+        assert _gauge_value(
+            registry, "slo_transitions_total", "availability", "ok"
+        ) == 1
+
+    def test_never_skips_from_ok_to_violated(self):
+        engine, clock = _engine([AVAIL])
+        self._inject_fault(engine, clock, bad=20, good=0)
+        for _ in range(5):
+            snap = engine.evaluate(now=clock.t)
+            clock.advance(1.0)
+        walk = [(t["from"], t["to"]) for t in snap["transitions"]]
+        assert walk[0] == ("ok", "burning")
+        assert walk[1] == ("burning", "violated")
+
+    def test_blip_does_not_violate(self):
+        # A short burst trips burning via the fast window, but the slow
+        # window never gets hot enough once the burst ages out: the
+        # machine must return to ok without ever touching violated.
+        spec = SLOSpec(name="avail", signal="availability", objective=0.99,
+                       fast_window_s=10.0, slow_window_s=600.0,
+                       fast_burn_threshold=10.0, slow_burn_threshold=30.0)
+        engine, clock = _engine([spec])
+        engine.record_request(ok=False, now=clock.t)
+        engine.record_request(ok=False, now=clock.t)
+        for _ in range(8):
+            engine.record_request(ok=True, now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        assert _spec_state(snap, "avail")["state"] == "burning"
+        clock.advance(30.0)
+        for _ in range(10):
+            engine.record_request(ok=True, now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        assert _spec_state(snap, "avail")["state"] == "ok"
+        states = {t["to"] for t in snap["transitions"]}
+        assert "violated" not in states
+
+    def test_violated_edge_dumps_parseable_blackbox(self, tmp_path):
+        # The acceptance wiring end-to-end: the violated transition dumps
+        # a real flight-recorder blackbox.json, parseable, with the SLO
+        # trip as the dump reason.
+        import json
+
+        from consensus_tpu.obs.trace import FlightRecorder
+
+        path = str(tmp_path / "blackbox.json")
+        recorder = FlightRecorder(path=path)
+        recorder.record_event("latency_fault_injected", fault="sleep")
+        clock = FakeClock()
+        engine = SLOEngine(
+            specs=[AVAIL], clock=clock,
+            dump_blackbox=lambda reason: recorder.dump(reason),
+        )
+        for _ in range(10):
+            engine.record_request(ok=False, now=clock.t)
+        engine.evaluate(now=clock.t)          # ok -> burning
+        clock.advance(1.0)
+        engine.evaluate(now=clock.t)          # burning -> violated: dump
+        with open(path, encoding="utf-8") as handle:
+            blackbox = json.load(handle)
+        assert blackbox["reason"] == "slo_violated:availability"
+        assert blackbox["events"][0]["kind"] == "latency_fault_injected"
+        assert recorder.dumps == 1
+
+    def test_dump_failure_does_not_poison_evaluate(self):
+        def explode(reason):
+            raise RuntimeError("disk full")
+
+        clock = FakeClock()
+        engine = SLOEngine(specs=[AVAIL], clock=clock, dump_blackbox=explode)
+        for _ in range(10):
+            engine.record_request(ok=False, now=clock.t)
+        engine.evaluate(now=clock.t)
+        clock.advance(1.0)
+        snap = engine.evaluate(now=clock.t)  # violated edge -> dump raises
+        assert _spec_state(snap, "availability")["state"] == "violated"
+
+
+# ---------------------------------------------------------------------------
+# Poll signals
+# ---------------------------------------------------------------------------
+
+
+KV_SPEC = SLOSpec(name="kv", signal="kv_headroom", objective=0.5,
+                  threshold=0.10)
+DRIFT_SPEC = SLOSpec(name="drift", signal="welfare_drift", objective=0.5)
+
+
+class TestPollSignals:
+    def test_kv_headroom_classified_against_threshold(self):
+        values = iter([0.05, 0.50, None])
+        engine, clock = _engine(
+            [KV_SPEC], signals={"kv_headroom": lambda: next(values)})
+        for _ in range(3):
+            engine.sample_signals(now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        # 0.05 < 0.10 bad, 0.50 good, None skipped entirely.
+        assert _spec_state(snap, "kv")["windows"]["fast"] == {
+            "good": 1, "bad": 1, "total": 2}
+
+    def test_welfare_drift_mapping_and_bool(self):
+        values = iter([{"drifted": True}, {"drifted": False},
+                       {"reason": "warming_up"}, True, False, None])
+        engine, clock = _engine(
+            [DRIFT_SPEC], signals={"welfare_drift": lambda: next(values)})
+        for _ in range(6):
+            engine.sample_signals(now=clock.t)
+        snap = engine.evaluate(now=clock.t)
+        # bad: {"drifted": True}, True.  good: {"drifted": False},
+        # warming-up mapping, False.  skipped: None.
+        assert _spec_state(snap, "drift")["windows"]["fast"] == {
+            "good": 3, "bad": 2, "total": 5}
+
+    def test_raising_signal_is_skipped(self):
+        def broken():
+            raise RuntimeError("stats endpoint down")
+
+        engine, clock = _engine(
+            [KV_SPEC], signals={"kv_headroom": broken})
+        snap = engine.evaluate(now=clock.t)
+        spec = _spec_state(snap, "kv")
+        assert spec["windows"]["fast"]["total"] == 0
+        assert spec["state"] == "ok"
+
+    def test_unregistered_signal_is_skipped(self):
+        engine, clock = _engine([KV_SPEC], signals={})
+        snap = engine.evaluate(now=clock.t)
+        assert _spec_state(snap, "kv")["windows"]["fast"]["total"] == 0
+
+    def test_poll_fault_drives_state_machine(self):
+        # objective 0.90 -> budget 0.10: an all-bad window burns at
+        # exactly 10.0, meeting the default fast threshold.
+        spec = SLOSpec(name="kv", signal="kv_headroom", objective=0.90,
+                       threshold=0.10)
+        values = iter([0.02] * 3 + [0.90] * 50)
+        engine, clock = _engine(
+            [spec], signals={"kv_headroom": lambda: next(values)})
+        states = []
+        for _ in range(3):
+            snap = engine.evaluate(now=clock.t)
+            states.append(_spec_state(snap, "kv")["state"])
+            clock.advance(1.0)
+        assert states == ["burning", "violated", "violated"]
+        clock.advance(spec.slow_window_s + 10.0)
+        for _ in range(10):
+            snap = engine.evaluate(now=clock.t)
+            clock.advance(1.0)
+        assert _spec_state(snap, "kv")["state"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# _EventWindow internals
+# ---------------------------------------------------------------------------
+
+
+class TestEventWindow:
+    def test_same_second_aggregates_into_one_bucket(self):
+        window = _EventWindow(horizon_s=60.0)
+        for _ in range(100):
+            window.add(10.4, bad=False)
+        window.add(10.9, bad=True)
+        assert len(window._buckets) == 1
+        assert window.counts(11.0, 60.0) == {
+            "good": 100, "bad": 1, "total": 101}
+
+    def test_window_cut_excludes_old_events(self):
+        window = _EventWindow(horizon_s=600.0)
+        window.add(0.0, bad=True)
+        window.add(100.0, bad=False)
+        assert window.counts(110.0, 60.0) == {
+            "good": 1, "bad": 0, "total": 1}
+        assert window.counts(110.0, 600.0)["total"] == 2
+
+    def test_horizon_pruning_bounds_memory(self):
+        window = _EventWindow(horizon_s=60.0)
+        for second in range(1000):
+            window.add(float(second), bad=False)
+        window.counts(1000.0, 60.0)
+        assert len(window._buckets) <= 62
+
+    def test_transition_log_is_bounded(self):
+        spec = SLOSpec(name="avail", signal="availability", objective=0.5,
+                       fast_window_s=1.0, slow_window_s=2.0,
+                       fast_burn_threshold=1.0, slow_burn_threshold=1.0)
+        clock = FakeClock()
+        engine = SLOEngine(specs=[spec], clock=clock,
+                           dump_blackbox=lambda r: None, max_transitions=4)
+        # Flap: alternate saturated-bad and drained windows.
+        for i in range(20):
+            engine.record_request(ok=False, now=clock.t)
+            engine.evaluate(now=clock.t)
+            clock.advance(5.0)
+            engine.record_request(ok=True, now=clock.t)
+            engine.evaluate(now=clock.t)
+            clock.advance(5.0)
+        assert len(engine.snapshot(now=clock.t)["transitions"]) == 4

@@ -51,6 +51,7 @@ from consensus_tpu.serve import (
     ConsensusServer,
     FleetRouter,
     Replica,
+    SchedulerRejected,
     create_server,
     parse_request,
 )
@@ -392,6 +393,66 @@ class TestEndToEndTrace:
             assert rejected, "capacity 1+1 under 8 concurrent posts must 429"
             for body in rejected:
                 assert body["error"]["request_id"].startswith("srv-")
+        finally:
+            server.stop(drain=False, timeout=5.0)
+
+    def _rejection_response(self, server, exc):
+        """POST (no client request_id) with submit forced to reject."""
+        scheduler = server.scheduler
+
+        def rejecting_submit(request):
+            raise exc
+
+        scheduler.submit = rejecting_submit
+        payload = _payload(seed=60)
+        del payload["request_id"]
+        request = urllib.request.Request(
+            server.base_url + "/v1/consensus",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=10.0):
+                raise AssertionError("rejection expected")
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read().decode()), err.headers
+
+    def test_breaker_open_503_carries_request_id(self):
+        server = create_server(
+            backend=FakeBackend(), port=0, registry=Registry()).start()
+        try:
+            status, body, headers = self._rejection_response(
+                server,
+                SchedulerRejected("breaker_open",
+                                  "circuit breaker open to backend",
+                                  retry_after_s=3.0),
+            )
+            assert status == 503
+            error = body["error"]
+            assert error["type"] == "rejected"
+            assert error["reason"] == "breaker_open"
+            assert error["request_id"].startswith("srv-")
+            assert headers["Retry-After"] is not None
+        finally:
+            server.stop(drain=False, timeout=5.0)
+
+    def test_kv_oom_413_carries_request_id(self):
+        server = create_server(
+            backend=FakeBackend(), port=0, registry=Registry()).start()
+        try:
+            status, body, headers = self._rejection_response(
+                server,
+                SchedulerRejected("kv_oom",
+                                  "request KV footprint exceeds pool"),
+            )
+            assert status == 413
+            error = body["error"]
+            assert error["type"] == "rejected"
+            assert error["reason"] == "kv_oom"
+            assert error["request_id"].startswith("srv-")
+            # Oversized requests don't shrink on retry: no Retry-After.
+            assert headers["Retry-After"] is None
         finally:
             server.stop(drain=False, timeout=5.0)
 

@@ -1,0 +1,448 @@
+"""Welfare telemetry plane end-to-end (ISSUE 16 tentpole layer 2).
+
+Acceptance proofs pinned here:
+
+* **Fleet federation is exact**: on a live 3-replica fake fleet, the
+  ``replica="fleet"`` latency sketch in the federated snapshot equals the
+  key-wise merge of the per-replica series — same stores, same p99 — and
+  its exemplars carry trace ids resolvable via ``GET /v1/trace/<id>``.
+* **Telemetry OFF is inert**: the same seeded requests produce identical
+  response bodies (modulo the wall-clock ``generation_time_s``) with
+  telemetry on and off, and an OFF registry grows no sketch families.
+* **Drift detection**: the ``welfare_drift`` condition stays silent on a
+  stationary reference workload, flags a median collapse AND a
+  p10-only skew (the worst-off tail moving while the median holds), and
+  ``welfare_drift_events_total`` counts each raise transition once.
+* **Tier accounting**: degraded responses are attributed to their tier
+  and ``serve_degraded_welfare_gap`` tracks full-minus-tier egalitarian
+  welfare.
+* **The score-matrix sink**: ``record_matrix`` feeds the chosen row's
+  welfare and worst-off utility; the module-level sink installs and
+  clears.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from consensus_tpu.obs.metrics import Registry
+from consensus_tpu.obs.sketch import merge_sketch_series, quantile_from_series
+from consensus_tpu.obs.welfare import (
+    ServeTelemetry,
+    WelfareDriftDetector,
+    get_welfare_sink,
+    set_welfare_sink,
+)
+from consensus_tpu.serve import create_server
+
+ISSUE = "Should we invest in public transport?"
+OPINIONS = {
+    "Agent 1": "Yes, buses and trains are vital public goods.",
+    "Agent 2": "Only alongside congestion pricing for cars.",
+    "Agent 3": "Prefer cycling infrastructure over big rail projects.",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clear_sink():
+    yield
+    set_welfare_sink(None)
+
+
+def _payload(seed=7, issue=ISSUE, **overrides):
+    payload = {
+        "issue": issue,
+        "agent_opinions": dict(OPINIONS),
+        "method": "best_of_n",
+        "params": {"n": 4, "max_tokens": 24},
+        "seed": seed,
+        "evaluate": True,
+        "request_id": f"req-{seed}",
+    }
+    payload.update(overrides)
+    return payload
+
+
+def _post(base_url, payload, timeout=30.0):
+    request = urllib.request.Request(
+        base_url + "/v1/consensus",
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(request, timeout=timeout) as response:
+        return response.status, json.loads(response.read().decode())
+
+
+def _get(base_url, path, timeout=10.0):
+    with urllib.request.urlopen(base_url + path, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _series(registry, family, **labels):
+    fam = registry.snapshot()["families"].get(family)
+    if fam is None:
+        return None
+    for series in fam["series"]:
+        if all(series["labels"].get(k) == v for k, v in labels.items()):
+            return series
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Drift detector
+# ---------------------------------------------------------------------------
+
+
+class TestWelfareDriftDetector:
+    def test_warming_up_until_min_samples(self):
+        det = WelfareDriftDetector(window=64, min_samples=32)
+        for _ in range(10):
+            det.observe(0.5)
+        status = det.status()
+        assert status["reason"] == "warming_up"
+        assert status["drifted"] is False
+
+    def test_silent_on_stationary_reference(self):
+        det = WelfareDriftDetector(window=64, min_samples=32)
+        pattern = [0.45, 0.5, 0.55, 0.5]
+        for i in range(200):
+            det.observe(pattern[i % 4])
+            assert det.status()["drifted"] is False
+
+    def test_flags_median_collapse(self):
+        det = WelfareDriftDetector(window=64, min_samples=32)
+        for _ in range(64):
+            det.observe(0.5)  # baseline auto-pins at sample 32
+        for _ in range(64):
+            det.observe(0.1)  # workload shifts
+        status = det.status()
+        assert status["drifted"] is True
+        assert status["shift"]["median"] > 0.25
+        assert status["baseline"]["median"] == pytest.approx(0.5, rel=0.02)
+        assert status["window"]["median"] == pytest.approx(0.1, rel=0.02)
+
+    def test_flags_p10_only_skew(self):
+        # The median holds at 0.5 while 15% of requests collapse to 0.1:
+        # exactly the shift that hurts the worst-off agents.  The p10 term
+        # must catch it.
+        det = WelfareDriftDetector(window=40, min_samples=20)
+        for _ in range(20):
+            det.observe(0.5)
+        for i in range(40):
+            det.observe(0.1 if i % 7 == 0 else 0.5)
+        status = det.status()
+        assert status["window"]["median"] == pytest.approx(0.5, rel=0.02)
+        assert status["shift"]["median"] < 0.05
+        assert status["shift"]["p10"] > 0.25
+        assert status["drifted"] is True
+
+    def test_pin_baseline_from_saved_snapshot(self):
+        reference = WelfareDriftDetector(window=64, min_samples=8)
+        for _ in range(16):
+            reference.observe(0.8)
+        saved = reference.baseline_snapshot()
+        assert saved is not None
+
+        det = WelfareDriftDetector(window=64, min_samples=8)
+        det.pin_baseline(saved)
+        for _ in range(8):
+            det.observe(0.79)  # near the shipped baseline: no drift
+        assert det.status()["drifted"] is False
+        for _ in range(64):
+            det.observe(0.2)
+        assert det.status()["drifted"] is True
+
+    def test_rejects_degenerate_window(self):
+        with pytest.raises(ValueError):
+            WelfareDriftDetector(window=1)
+        with pytest.raises(ValueError):
+            WelfareDriftDetector(min_samples=1)
+
+
+# ---------------------------------------------------------------------------
+# ServeTelemetry unit behavior (no server)
+# ---------------------------------------------------------------------------
+
+
+def _evaluated_value(egal=0.3, util=0.5, nash=0.4, worst=0.2, **extra):
+    value = {
+        "welfare": {
+            "egalitarian_welfare_cosine": egal,
+            "utilitarian_welfare_cosine": util,
+            "log_nash_welfare_cosine": nash,
+        },
+        "utilities": {
+            "a": {"cosine_similarity": worst},
+            "b": {"cosine_similarity": 0.8},
+        },
+    }
+    value.update(extra)
+    return value
+
+
+class TestServeTelemetry:
+    def test_record_request_feeds_sketches_and_gap(self):
+        registry = Registry()
+        telemetry = ServeTelemetry(registry=registry)
+        telemetry.record_request(
+            "best_of_n", "ok", latency_s=0.25,
+            value=_evaluated_value(), replica="r0", trace_id="req-1",
+        )
+        latency = _series(registry, "serve_latency_sketch_seconds",
+                          replica="r0", outcome="ok")
+        assert latency["count"] == 1
+        assert latency["exemplars"][0]["trace_id"] == "req-1"
+        assert _series(registry, "welfare_egalitarian",
+                       replica="r0")["count"] == 1
+        assert _series(registry, "min_agent_utility",
+                       replica="r0")["sum"] == pytest.approx(0.2)
+        gap = _series(registry, "welfare_gap_util_egal", replica="r0")
+        assert gap["value"] == pytest.approx(0.5 - 0.3)
+
+    def test_unevaluated_request_records_latency_only(self):
+        registry = Registry()
+        telemetry = ServeTelemetry(registry=registry)
+        telemetry.record_request("best_of_n", "ok", latency_s=0.1,
+                                 value={"statement": "s"}, replica="r0")
+        assert _series(registry, "serve_latency_sketch_seconds",
+                       replica="r0", outcome="ok")["count"] == 1
+        assert _series(registry, "welfare_egalitarian",
+                       replica="r0") is None
+
+    def test_garbage_value_never_raises(self):
+        telemetry = ServeTelemetry(registry=Registry())
+        telemetry.record_request("m", "ok", latency_s=0.1, value="not a dict")
+        telemetry.record_request(
+            "m", "ok", latency_s=0.1,
+            value={"welfare": {"egalitarian_welfare_cosine": "NaNsense"},
+                   "utilities": {"a": {}}},
+        )
+        telemetry.record_request("m", "failed", latency_s=float("nan"))
+
+    def test_degraded_tier_gap_accounting(self):
+        registry = Registry()
+        telemetry = ServeTelemetry(registry=registry)
+        for _ in range(2):
+            telemetry.record_request(
+                "m", "ok", 0.1, value=_evaluated_value(egal=0.6))
+        telemetry.record_request(
+            "m", "degraded", 0.1,
+            value=_evaluated_value(egal=0.2, degraded=True),
+            tier="brownout2",
+        )
+        gap = _series(registry, "serve_degraded_welfare_gap",
+                      tier="brownout2")
+        assert gap["value"] == pytest.approx(0.4)
+        assert _series(registry, "welfare_by_tier",
+                       tier="full")["count"] == 2
+        assert _series(registry, "welfare_by_tier",
+                       tier="brownout2")["count"] == 1
+        snap = telemetry.snapshot()
+        assert snap["tiers"]["full"]["mean"] == pytest.approx(0.6)
+        assert snap["tiers"]["brownout2"]["mean"] == pytest.approx(0.2)
+
+    def test_degraded_reason_fallback_when_tier_unset(self):
+        registry = Registry()
+        telemetry = ServeTelemetry(registry=registry)
+        telemetry.record_request(
+            "m", "degraded", 0.1,
+            value=_evaluated_value(egal=0.2, degraded=True,
+                                   degraded_reason="anytime_partial"),
+        )
+        assert _series(registry, "welfare_by_tier",
+                       tier="anytime_partial")["count"] == 1
+
+    def test_drift_event_counter_increments_once_per_raise(self):
+        registry = Registry()
+        telemetry = ServeTelemetry(
+            registry=registry,
+            drift_options={"window": 32, "min_samples": 8},
+        )
+        for _ in range(8):
+            telemetry.record_request(
+                "m", "ok", 0.1, value=_evaluated_value(egal=0.5))
+        for _ in range(40):
+            telemetry.record_request(
+                "m", "ok", 0.1, value=_evaluated_value(egal=0.1))
+        assert _series(registry, "welfare_drift")["value"] == 1.0
+        # Raised once, not once per drifted observation.
+        assert _series(registry, "welfare_drift_events_total")["value"] == 1
+        assert telemetry.drift_status()["drifted"] is True
+
+    def test_record_matrix_feeds_score_path(self):
+        registry = Registry()
+        telemetry = ServeTelemetry(registry=registry)
+
+        class FakeResult:
+            welfare = np.array([0.2, 0.7, 0.4])
+            best = 1
+            utilities = np.array([[0.1, 0.3], [0.6, 0.9], [0.2, 0.5]])
+
+        telemetry.record_matrix(FakeResult(), welfare_rule="egalitarian")
+        chosen = _series(registry, "score_path_welfare", rule="egalitarian")
+        assert chosen["count"] == 1 and chosen["sum"] == pytest.approx(0.7)
+        worst = _series(registry, "score_path_min_agent_utility")
+        assert worst["sum"] == pytest.approx(0.6)
+        # Malformed results never raise.
+        telemetry.record_matrix(object())
+
+    def test_sink_installs_and_clears(self):
+        telemetry = ServeTelemetry(registry=Registry())
+        assert get_welfare_sink() is None
+        assert set_welfare_sink(telemetry) is telemetry
+        assert get_welfare_sink() is telemetry
+        set_welfare_sink(None)
+        assert get_welfare_sink() is None
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: byte-identity with telemetry off
+# ---------------------------------------------------------------------------
+
+
+def _serve_bodies(telemetry, registry, seeds=(7, 8, 9)):
+    server = create_server(
+        backend="fake", port=0, registry=registry, max_inflight=4,
+        telemetry=telemetry, slo=telemetry,
+    ).start()
+    try:
+        bodies = []
+        for seed in seeds:
+            status, body = _post(server.base_url, _payload(seed=seed))
+            assert status == 200
+            # The only wall-clock field in a response.
+            body.pop("generation_time_s")
+            bodies.append(json.dumps(body, sort_keys=True))
+        return bodies
+    finally:
+        server.stop(drain=False, timeout=5.0)
+        set_welfare_sink(None)
+
+
+class TestTelemetryOffIdentity:
+    def test_responses_identical_on_vs_off(self):
+        on = _serve_bodies(True, Registry())
+        off = _serve_bodies(False, Registry())
+        assert on == off
+
+    def test_off_registry_grows_no_telemetry_families(self):
+        registry = Registry()
+        _serve_bodies(False, registry)
+        families = registry.snapshot()["families"]
+        assert "serve_latency_sketch_seconds" not in families
+        assert not any(name.startswith("welfare") for name in families)
+        assert "slo_state" not in families
+
+    def test_off_surfaces_absent(self):
+        registry = Registry()
+        server = create_server(
+            backend="fake", port=0, registry=registry, max_inflight=4,
+        ).start()
+        try:
+            status, health = _get(server.base_url, "/healthz")
+            assert "welfare" not in health and "slo" not in health
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.base_url, "/v1/slo")
+            assert err.value.code == 404
+        finally:
+            server.stop(drain=False, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: fleet federation + exemplar linkage + live surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestFleetFederation:
+    def test_fleet_p99_is_exactly_the_pooled_p99(self):
+        registry = Registry()
+        server = create_server(
+            backend="fake", port=0, registry=registry, fleet_size=3,
+            max_inflight=2, max_queue_depth=16, telemetry=True, slo=True,
+        ).start()
+        try:
+            # Varied issues: scenario affinity would otherwise pin every
+            # request to one replica and federation would be trivial.
+            for i in range(12):
+                status, body = _post(
+                    server.base_url,
+                    _payload(seed=100 + i, issue=f"{ISSUE} (variant {i})"),
+                )
+                assert status == 200
+
+            fed = server.scheduler.federated_metrics_snapshot()
+            family = fed["families"]["serve_latency_sketch_seconds"]
+            accuracy = family.get("relative_accuracy", 0.01)
+            fleet_body = None
+            replica_bodies = []
+            for series in family["series"]:
+                if series["labels"].get("outcome") != "ok":
+                    continue
+                body = {k: v for k, v in series.items() if k != "labels"}
+                if series["labels"]["replica"] == "fleet":
+                    fleet_body = body
+                else:
+                    replica_bodies.append(body)
+            assert fleet_body is not None
+            assert len(replica_bodies) >= 2, (
+                "load did not spread; federation proof needs >= 2 replicas"
+            )
+
+            pooled = dict(replica_bodies[0])
+            for extra in replica_bodies[1:]:
+                merge_sketch_series(pooled, extra)
+            assert pooled["pos"] == fleet_body["pos"]
+            assert pooled["count"] == fleet_body["count"]
+            for q in (0.5, 0.9, 0.99):
+                assert quantile_from_series(
+                    fleet_body, q, accuracy
+                ) == quantile_from_series(pooled, q, accuracy)
+
+            # Exemplar linkage: a federated exemplar resolves to a trace.
+            exemplars = fleet_body["exemplars"]
+            assert exemplars, "federated sketch lost its exemplars"
+            trace_id = exemplars[0]["trace_id"]
+            status, trace = _get(server.base_url, f"/v1/trace/{trace_id}")
+            assert status == 200
+            assert trace["trace_id"] == trace_id
+
+            # The text /metrics surface carries the federated series too.
+            metrics = urllib.request.urlopen(
+                server.base_url + "/metrics", timeout=5).read().decode()
+            assert 'replica="fleet"' in metrics
+
+            # Live /healthz + /v1/slo while telemetry is on.
+            status, health = _get(server.base_url, "/healthz")
+            assert health["welfare"]["drift"]["condition"] == "welfare_drift"
+            assert "slo" in health
+            status, slo = _get(server.base_url, "/v1/slo")
+            assert {s["name"] for s in slo["specs"]} >= {
+                "availability", "latency_p95", "welfare_drift"}
+        finally:
+            server.stop(drain=False, timeout=10.0)
+            set_welfare_sink(None)
+
+    def test_loadgen_reports_welfare_and_slo_blocks(self):
+        from consensus_tpu.serve.loadgen import run_loadgen
+
+        server = create_server(
+            backend="fake", port=0, registry=Registry(), max_inflight=4,
+            telemetry=True, slo=True,
+        ).start()
+        try:
+            payloads = [_payload(seed=200 + i) for i in range(6)]
+            report = run_loadgen(server.base_url, payloads, rate_rps=50.0,
+                                 include_slo=True)
+        finally:
+            server.stop(drain=False, timeout=5.0)
+            set_welfare_sink(None)
+        assert report["availability"] == 1.0
+        welfare = report["welfare"]
+        assert welfare["evaluated"] == 6
+        assert welfare["egalitarian_mean"] is not None
+        assert welfare["min_agent_utility_p5"] is not None
+        assert report["slo"]["worst"] in ("ok", "burning", "violated")
+        assert "availability" in report["slo"]["specs"]
