@@ -80,7 +80,11 @@ class BatchingBackend:
     notably ``{"decode_steps": K}`` turns on multi-token decode: the engine
     dispatches K-step on-device decode windows per cohort
     (``inner.generate_stream``) instead of one blocking ``generate`` call,
-    overlapping host admission/prefill with device decode.
+    overlapping host admission/prefill with device decode.  Adding
+    ``{"speculative": true}`` upgrades each window to draft-and-verify:
+    an n-gram self-draft proposes K tokens per row and one dispatch
+    verifies them, emitting ``1 + accepted`` real tokens per window with
+    byte-identical output (exact sequential PRNG replay).
     """
 
     name = "batching"

@@ -189,6 +189,7 @@ class DecodeEngine:
         mesh: Optional[Any] = None,
         watchdog_timeout_s: Optional[float] = None,
         decode_steps: Optional[int] = None,
+        speculative: bool = False,
     ):
         self.inner = inner
         self.n_slots = max(1, int(slots))
@@ -204,6 +205,24 @@ class DecodeEngine:
         self.decode_steps = (
             max(1, int(decode_steps)) if decode_steps is not None else None
         )
+        #: Engine-native speculative decoding: each decode window drafts K
+        #: tokens per row (n-gram self-draft) and verifies them in ONE
+        #: dispatch, emitting ``1 + accepted`` real tokens instead of 1.
+        #: Off by default — the plain ``paged_decode_steps`` byte-path is
+        #: untouched; on, results stay byte-identical (exact sequential
+        #: PRNG replay) while tokens-per-dispatch floats with acceptance.
+        #: Requires ``decode_steps`` (the draft window IS the decode
+        #: window); backends without the stream seam fall back exactly
+        #: like plain multi-token decode.
+        self.speculative = bool(speculative)
+        if self.speculative and self.decode_steps is None:
+            # The draft window IS the decode window; speculative alone
+            # implies a default K so ``{"speculative": true}`` works.
+            self.decode_steps = 4
+        #: Cumulative draft accounting across streams (stats / ledger).
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        self._stream_spec_seen = (0, 0)
         self._stream: Optional[Any] = None
         self._stream_slots: List[Optional["_Slot"]] = []
         # Mesh mode: ``mesh`` is a {'dp': N, 'tp': M} dict, a "dp=4,tp=2"
@@ -457,6 +476,8 @@ class DecodeEngine:
         self._iter_block_s = 0.0
         self._iter_merge_s = 0.0
         self._iter_tokens = 0
+        self._iter_spec_proposed = 0
+        self._iter_spec_accepted = 0
 
         self._thread: Optional[threading.Thread] = None
         if auto_start:
@@ -616,6 +637,22 @@ class DecodeEngine:
                     self.decoded_tokens / self.decode_windows
                     if self.decode_windows else 0.0
                 ),
+                "speculative": {
+                    "enabled": self.speculative,
+                    "proposed_tokens": self.spec_proposed_tokens,
+                    "accepted_tokens": self.spec_accepted_tokens,
+                    # Mean draft tokens accepted per device dispatch — each
+                    # window emits 1 + accepted real tokens, so anything > 0
+                    # is throughput past the fixed-K floor.
+                    "accepted_tokens_per_dispatch": (
+                        self.spec_accepted_tokens / self.decode_windows
+                        if self.decode_windows else 0.0
+                    ),
+                    "draft_acceptance_rate": (
+                        self.spec_accepted_tokens / self.spec_proposed_tokens
+                        if self.spec_proposed_tokens else 0.0
+                    ),
+                },
                 "backend_lost": self.backend_lost,
                 "mfu_attribution": self.ledger.mfu_attribution(),
                 "watchdog": {
@@ -701,6 +738,8 @@ class DecodeEngine:
         self._iter_block_s = 0.0
         self._iter_merge_s = 0.0
         self._iter_tokens = 0
+        self._iter_spec_proposed = 0
+        self._iter_spec_accepted = 0
         with self._lock:
             t0 = time.perf_counter()
             self._process_cancellations()
@@ -774,6 +813,8 @@ class DecodeEngine:
                 cohort=len(cohort),
                 queue_depth=queue_depth,
                 pages_in_use=pages_in_use,
+                spec_proposed=self._iter_spec_proposed,
+                spec_accepted=self._iter_spec_accepted,
             )
             self._last_iter_end = t_end
             get_flight_recorder().record_iteration(row)
@@ -1042,9 +1083,15 @@ class DecodeEngine:
                 decode_steps=self.decode_steps)
         t_disp = time.perf_counter()
         try:
-            stream = self.inner.generate_stream(
-                requests, decode_steps=self.decode_steps
-            )
+            if self.speculative:
+                stream = self.inner.generate_stream(
+                    requests, decode_steps=self.decode_steps,
+                    speculative=True,
+                )
+            else:
+                stream = self.inner.generate_stream(
+                    requests, decode_steps=self.decode_steps
+                )
             stream.dispatch()
         except Exception as exc:
             self._iter_dispatch_s += time.perf_counter() - t_disp
@@ -1062,6 +1109,7 @@ class DecodeEngine:
         self._iter_dispatch_s += time.perf_counter() - t_disp
         self._stream = stream
         self._stream_slots = list(cohort)
+        self._stream_spec_seen = (0, 0)
 
     def _advance_stream(self) -> None:
         """Collect the in-flight K-step window (the only point that blocks
@@ -1079,6 +1127,16 @@ class DecodeEngine:
             return
         self._iter_block_s += time.perf_counter() - t_block
 
+        # Draft accounting: the stream's cumulative counters advance at
+        # dispatch (proposed) and collect (accepted); the delta since the
+        # last read is this window's contribution.
+        spec_proposed = int(getattr(stream, "spec_proposed", 0) or 0)
+        spec_accepted = int(getattr(stream, "spec_accepted", 0) or 0)
+        seen_p, seen_a = self._stream_spec_seen
+        self._stream_spec_seen = (spec_proposed, spec_accepted)
+        self._iter_spec_proposed += spec_proposed - seen_p
+        self._iter_spec_accepted += spec_accepted - seen_a
+
         t_merge = time.perf_counter()
         with self._lock:
             tokens = sum(row_tokens)
@@ -1087,6 +1145,8 @@ class DecodeEngine:
             self._m_tokens_dispatch.observe(tokens)
             self.decode_windows += 1
             self.decoded_tokens += tokens
+            self.spec_proposed_tokens += spec_proposed - seen_p
+            self.spec_accepted_tokens += spec_accepted - seen_a
             for i, result in finished.items():
                 slot = self._stream_slots[i]
                 if slot is None:

@@ -617,7 +617,10 @@ class TPUBackend:
         return self._sliced(requests, self._generate_impl, limit=256)
 
     def generate_stream(
-        self, requests: Sequence[GenerationRequest], decode_steps: int = 1
+        self,
+        requests: Sequence[GenerationRequest],
+        decode_steps: int = 1,
+        speculative: bool = False,
     ) -> "_PagedGenerateStream":
         """Multi-token decode stream (engine ``decode_steps`` seam).
 
@@ -629,8 +632,16 @@ class TPUBackend:
         and finalizes rows that froze inside it with the exact
         ``_finish_generation`` semantics.  Sampling replays the sequential
         per-row key-split schedule, so emitted tokens are independent of K.
+
+        With ``speculative=True`` each window instead drafts K tokens per
+        row from an n-gram self-proposer and verifies them in ONE
+        ``paged_verify_steps`` dispatch — ``1 + accepted`` real tokens per
+        window instead of 1 per scan step, byte-identical token streams
+        (exact sequential PRNG replay).
         """
-        return _PagedGenerateStream(self, list(requests), decode_steps)
+        return _PagedGenerateStream(
+            self, list(requests), decode_steps, speculative=speculative
+        )
 
     def _seg_len_for(self, max_new: int) -> Optional[int]:
         """Segment length for a decode budget, or None for monolithic.
@@ -1815,6 +1826,7 @@ class _PagedGenerateStream:
         backend: "TPUBackend",
         requests: List[GenerationRequest],
         decode_steps: int,
+        speculative: bool = False,
     ):
         from consensus_tpu.models import stepper
         from consensus_tpu.models.generate import _prompt_presence
@@ -1824,6 +1836,7 @@ class _PagedGenerateStream:
         self.backend = be
         self.requests = requests
         self.decode_steps = max(1, int(decode_steps))
+        self.speculative = bool(speculative)
         self._mesh = be.mesh_plan.mesh if be.mesh_plan is not None else None
         self._pending = None
         self._closed = False
@@ -1948,6 +1961,35 @@ class _PagedGenerateStream:
         else:
             self._presence = None
 
+        #: Cumulative draft accounting the engine reads after collect().
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        if self.speculative:
+            from consensus_tpu.backends.speculative import NGramProposer
+
+            # One n-gram self-draft table per row, seeded from the row's
+            # OWN prompt; emitted tokens feed it at collect() — the
+            # lookup-decoding seam generate traffic was missing.
+            self._proposers = [NGramProposer() for _ in requests]
+            self._ctx: List[List[int]] = []
+            for proposer, ids in zip(self._proposers, prompt_ids):
+                proposer.observe(ids)
+                self._ctx.append(list(ids))
+            self._target = target
+            self._pending_tok = jnp.zeros(target, jnp.int32)
+            self._has_pending = False
+            reg = be.instruments.registry
+            self._obs_spec_proposed = reg.counter(
+                "spec_draft_proposed_tokens_total",
+                "Draft tokens proposed for speculative rollout verification",
+                ("backend",),
+            ).labels(be.name)
+            self._obs_spec_verified = reg.counter(
+                "spec_draft_verified_tokens_total",
+                "Draft tokens accepted by the parallel verify pass",
+                ("backend",),
+            ).labels(be.name)
+
     @property
     def finished(self) -> bool:
         return self._closed or len(self._finished_rows) >= self._n_rows
@@ -1956,6 +1998,9 @@ class _PagedGenerateStream:
         """Enqueue one K-step window.  Returns without fetching — the
         device arrays stay in flight until ``collect()``."""
         if self._closed or self._pending is not None or self.finished:
+            return
+        if self.speculative:
+            self._dispatch_verify()
             return
         (tokens, emitted, self._logits, self._state, self._lengths,
          self._keys, self._done, self._budgets, self._hit_eos,
@@ -1970,7 +2015,45 @@ class _PagedGenerateStream:
             presence=self._presence, rep_penalty=self._rep_penalty,
             mesh=self._mesh,
         )
-        self._pending = (tokens, emitted, self._done, self._hit_eos)
+        self._pending = (tokens, emitted, None, self._done, self._hit_eos)
+
+    def _dispatch_verify(self) -> None:
+        """Speculative window: draft K tokens per live row on the host,
+        verify them in ONE ``paged_verify_steps`` dispatch.  The drafts
+        ride the same async-dispatch seam — drafting happens between
+        collect() and dispatch(), so the double-buffer overlap of the
+        plain stream is preserved."""
+        k = self.decode_steps
+        drafts = np.zeros((self._target, k), np.int32)
+        live = 0
+        for row in range(self._n_rows):
+            if row in self._finished_rows:
+                continue
+            drafts[row] = self._proposers[row].draft(self._ctx[row], k)
+            live += 1
+        self.spec_proposed += live * k
+        self._obs_spec_proposed.inc(live * k)
+        (tokens, emitted, accepted, self._pending_tok, self._state,
+         self._lengths, self._keys, self._done, self._budgets,
+         self._hit_eos, self._presence) = self._stepper.paged_verify_steps(
+            self.backend.params, self.backend.config, self._logits,
+            self._state, self._tables, self._lengths, self._keys,
+            self._done, self._budgets, self._hit_eos,
+            temperature=self._temperatures,
+            draft_tokens=jnp.asarray(drafts), pending=self._pending_tok,
+            eos_ids=self._eos_ids, num_steps=k,
+            bias_table=self._bias_table, bias_index=self._bias_index,
+            pad_id=self.backend.tokenizer.pad_id,
+            presence=self._presence, rep_penalty=self._rep_penalty,
+            has_pending=self._has_pending, mesh=self._mesh,
+        )
+        # The carried prefill logits are consumed by the FIRST window;
+        # every later first-decision sample re-derives its logits from the
+        # pending column's hidden on device.
+        self._logits = None
+        self._has_pending = True
+        self._pending = (tokens, emitted, accepted, self._done,
+                         self._hit_eos)
 
     def collect(self) -> Tuple[List[int], Dict[int, GenerationResult]]:
         """Block on the pending window; return (per-row emitted counts,
@@ -1978,7 +2061,12 @@ class _PagedGenerateStream:
         if self._pending is None:
             raise RuntimeError("collect() before dispatch()")
         be = self.backend
-        tokens, emitted, done, hit = be._fetch(*self._pending)
+        tokens, emitted, accepted = self._pending[:3]
+        if accepted is None:
+            tokens, emitted, done, hit = be._fetch(*self._pending[:2],
+                                                   *self._pending[3:])
+        else:
+            tokens, emitted, accepted, done, hit = be._fetch(*self._pending)
         self._pending = None
         row_tokens = [0] * self._n_rows
         newly_finished: Dict[int, GenerationResult] = {}
@@ -1988,11 +2076,20 @@ class _PagedGenerateStream:
             ids = [int(t) for t, e in zip(tokens[row], emitted[row]) if e]
             self._ids[row].extend(ids)
             row_tokens[row] = len(ids)
+            if accepted is not None and ids:
+                self._proposers[row].observe(ids)
+                self._ctx[row].extend(ids)
             if bool(done[row]):
                 self._finished_rows.add(row)
                 result = self._finish_row(row, bool(hit[row]))
                 self._results[row] = result
                 newly_finished[row] = result
+        if accepted is not None:
+            window_accepted = int(
+                sum(int(accepted[row]) for row in range(self._n_rows))
+            )
+            self.spec_accepted += window_accepted
+            self._obs_spec_verified.inc(window_accepted)
         if self.finished:
             be.instruments.record_padding(
                 "generate_decode", self._n_rows,
@@ -2036,6 +2133,8 @@ class _PagedGenerateStream:
         self._pending = None
         self._state = None
         self._logits = None
+        if self.speculative:
+            self._pending_tok = None
 
 
 class TPUTokenSearchSession:

@@ -1005,6 +1005,224 @@ def paged_decode_steps(
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=(
+        "config", "num_steps", "has_pending", "top_k", "top_p", "pad_id",
+        "mesh",
+    ),
+    donate_argnums=(3,),
+)
+def paged_verify_steps(
+    params,
+    config: ModelConfig,
+    logits: jax.Array,  # (B, V) f32 — first-decision logits (prefill out);
+    #                     read only when ``has_pending`` is False
+    state: PagedSlotState,
+    block_tables: jax.Array,  # (B, max_blocks) int32, -1 padded
+    lengths: jax.Array,  # (B,) int32 — tokens whose K/V is WRITTEN (the
+    #                      pending token, when present, is NOT counted)
+    keys: jax.Array,  # (B, 2) per-row PRNG keys
+    done: jax.Array,  # (B,) bool
+    budgets: jax.Array,  # (B,) int32 — remaining emit budget
+    hit_eos: jax.Array,  # (B,) bool
+    temperature: jax.Array,  # (B,) float32 (or scalar)
+    draft_tokens: jax.Array,  # (B, K) int32 — per-row self-draft proposal
+    pending: jax.Array,  # (B,) int32 — last emitted token, K/V unwritten
+    eos_ids: Optional[jax.Array] = None,  # (E,) int32
+    num_steps: int = 1,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    logit_bias: Optional[jax.Array] = None,
+    bias_table: Optional[jax.Array] = None,
+    bias_index: Optional[jax.Array] = None,
+    pad_id: int = 0,
+    presence: Optional[jax.Array] = None,  # (B, V) bool seen-token mask
+    rep_penalty: Optional[jax.Array] = None,  # (B,) float32
+    has_pending: bool = False,
+    mesh: Optional[Mesh] = None,
+):
+    """Draft-and-verify variant of :func:`paged_decode_steps`: ONE window
+    emits ``1 + accepted`` real tokens instead of 1 per scan step.
+
+    The K per-row draft tokens are teacher-forced through ONE parallel
+    ``_paged_forward`` (S = K, or K+1 with the pending column), then K+1
+    sampling DECISIONS replay the sequential per-row key-split schedule
+    exactly — decision t splits the row's key and samples from the logits
+    the sequential scan would have carried at that step, so the accepted
+    prefix plus the first correction token reproduce the sequential
+    sampling decisions bit-for-bit (Leviathan et al. rejection, the same
+    contract ``rollout_verify_many`` pins for score-only rollouts).  A row
+    stops deciding the moment it diverges from its draft (the
+    teacher-forced context past that column is wrong); its key state has
+    then consumed exactly as many splits as decisions made, so the NEXT
+    window resumes the sequential schedule unchanged — keys only advance
+    on real decisions, which IS the rewind.
+
+    Pending-token protocol: a correction (or the bonus token sampled after
+    a fully-accepted draft) is emitted without its K/V being written — the
+    next window forwards it as column 0 (``has_pending=True``) and derives
+    the first decision's logits from its hidden, so ``lengths`` always
+    counts exactly the K/V-written tokens and the conservative
+    ``ceil((prompt + max_tokens) / page_size)`` reservation stays valid
+    under variable emission: every position a REAL decision's logits
+    depend on is < prompt + max_tokens.
+
+    Write discipline: draft columns write their K/V optimistically into
+    the pages the cursors name (later columns must attend earlier ones),
+    but a column is routed to the SINK when its row was done at entry or
+    its position falls past the block table (never clamp-and-write — the
+    decode path's clamp would wrap a past-table position into the LAST
+    page at a low offset and corrupt live K/V).  Rejected-tail writes that
+    did land in pool pages sit past the row's final ``lengths``, masked
+    out of every attention read and overwritten when those positions go
+    live.
+
+    Returns ``(tokens (B, K+1), emitted (B, K+1), accepted (B,) int32,
+    pending, state, lengths, keys, done, budgets, hit_eos, presence)`` —
+    ``accepted`` counts emitted draft matches (excluding the correction /
+    bonus token), and the trailing tuple re-enters the next window's
+    dispatch with ``has_pending=True``.
+    """
+    batch = draft_tokens.shape[0]
+    assert draft_tokens.shape[1] == num_steps, (
+        "draft_tokens must carry num_steps columns"
+    )
+    page_size = state.k_pages.shape[2]
+    sink = state.k_pages.shape[1] - 1
+    max_blocks = block_tables.shape[1]
+    if eos_ids is None:
+        eos_ids = jnp.zeros((0,), jnp.int32)
+    if bias_table is not None:
+        logit_bias = bias_table[bias_index]
+    if logits is not None:
+        logits = _constrain(logits, mesh, "data", "model")
+    block_tables = _constrain(block_tables, mesh, "data", None)
+    lengths = _constrain(lengths, mesh, "data")
+    keys = _constrain(keys, mesh, "data", None)
+    done = _constrain(done, mesh, "data")
+    budgets = _constrain(budgets, mesh, "data")
+    hit_eos = _constrain(hit_eos, mesh, "data")
+    draft_tokens = _constrain(draft_tokens, mesh, "data", None)
+    pending = _constrain(pending, mesh, "data")
+    state = _constrain_state(state, mesh)
+    use_rp = presence is not None and rep_penalty is not None
+    done_entry = done
+
+    # ---- one teacher-forced forward over the window's columns ----------
+    if has_pending:
+        cols = jnp.concatenate([pending[:, None], draft_tokens], axis=1)
+    else:
+        cols = draft_tokens
+    s = cols.shape[1]
+    positions = lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    page_idx = positions // page_size
+    in_table = page_idx < max_blocks
+    page = jnp.take_along_axis(
+        block_tables, jnp.minimum(page_idx, max_blocks - 1), axis=1
+    )
+    write_pages = jnp.where(
+        done_entry[:, None] | ~in_table | (page < 0), sink, page
+    )
+    write_offsets = jnp.where(done_entry[:, None], 0, positions % page_size)
+    attn_lengths = jnp.where(
+        done_entry, lengths,
+        jnp.minimum(lengths + s, max_blocks * page_size),
+    )
+    hidden, state = _paged_forward(
+        params, config, cols, positions, state,
+        block_tables, attn_lengths, write_pages, write_offsets,
+    )
+    state = _constrain_state(state, mesh)
+
+    # Decision t (t = 0..K) samples the token at new position t.  Its
+    # context is the written stream plus [pending?, d_0..d_{t-1}] — with a
+    # pending column that is hidden column t, without one it is column
+    # t-1 (decision 0 then samples from the CARRIED prefill logits, the
+    # exact first sample of the sequential path).
+    if has_pending:
+        first_logits = project_logits(params, config, hidden[:, 0, :])
+        dec_hidden = hidden[:, 1:, :]  # (B, K, D)
+    else:
+        first_logits = logits
+        dec_hidden = hidden  # (B, K, D)
+    first_logits = _constrain(first_logits, mesh, "data", "model")
+
+    def is_eos(token: jax.Array) -> jax.Array:
+        if eos_ids.shape[0] == 0:
+            return jnp.zeros_like(token, dtype=jnp.bool_)
+        return jnp.any(token[:, None] == eos_ids[None, :], axis=-1)
+
+    def decision(carry, logits_t, draft_t):
+        (keys, done, budgets, hit_eos, ok, accepted, pending) = carry[:7]
+        pres = carry[7] if use_rp else None
+        real = ok & ~done
+        pairs = jax.vmap(jax.random.split)(keys)
+        keys = jnp.where(real[:, None], pairs[:, 0], keys)
+        token = sample_tokens(
+            pairs[:, 1], logits_t, temperature=temperature, top_k=top_k,
+            top_p=top_p, logit_bias=logit_bias,
+            presence=pres, rep_penalty=rep_penalty if use_rp else None,
+        )
+        token = jnp.where(real, token, pad_id)
+        if use_rp:
+            updated = pres.at[jnp.arange(batch), token].set(True)
+            pres = jnp.where(real[:, None], updated, pres)
+        token_is_eos = is_eos(token) & real
+        emit = real & ~token_is_eos & (budgets > 0)
+        done = done | (real & (token_is_eos | (budgets <= 0)))
+        hit_eos = hit_eos | token_is_eos
+        budgets = budgets - emit.astype(jnp.int32)
+        # A row keeps deciding only while every emitted token matched its
+        # draft; the correction / bonus token (draft -1 never matches)
+        # ends the row's window with that token left pending.
+        matched = emit & (token == draft_t)
+        accepted = accepted + matched.astype(jnp.int32)
+        pending = jnp.where(emit, token, pending)
+        out = (keys, done, budgets, hit_eos, matched, accepted, pending)
+        return out + ((pres,) if use_rp else ()), (token, emit)
+
+    carry = (
+        keys, done, budgets, hit_eos,
+        jnp.ones((batch,), jnp.bool_), jnp.zeros((batch,), jnp.int32),
+        pending,
+    ) + ((presence,) if use_rp else ())
+    carry, (tok0, emit0) = decision(carry, first_logits, draft_tokens[:, 0])
+
+    drafts_rest = jnp.concatenate(
+        [draft_tokens[:, 1:], jnp.full((batch, 1), -1, jnp.int32)], axis=1
+    )  # (B, K): d_1..d_{K-1} then the bonus sentinel
+
+    def scan_step(carry, xs):
+        h_col, d_col = xs  # (B, D), (B,)
+        logits_t = project_logits(params, config, h_col)
+        logits_t = _constrain(logits_t, mesh, "data", "model")
+        return decision(carry, logits_t, d_col)
+
+    carry, (tok_rest, emit_rest) = jax.lax.scan(
+        scan_step, carry,
+        (jnp.moveaxis(dec_hidden, 0, 1), jnp.moveaxis(drafts_rest, 0, 1)),
+    )
+    (keys, done, budgets, hit_eos, _, accepted, pending) = carry[:7]
+    presence = carry[7] if use_rp else None
+    written = accepted
+    if has_pending:
+        # The carried pending token's K/V went live this window (done-at-
+        # entry rows wrote sink and stay frozen).
+        written = written + (~done_entry).astype(jnp.int32)
+    lengths = lengths + written
+    tokens_out = jnp.concatenate(
+        [tok0[:, None], jnp.swapaxes(tok_rest, 0, 1)], axis=1
+    )  # (B, K+1) int32
+    emitted_out = jnp.concatenate(
+        [emit0[:, None], jnp.swapaxes(emit_rest, 0, 1)], axis=1
+    )  # (B, K+1) bool
+    return (
+        tokens_out, emitted_out, accepted, pending, state, lengths,
+        keys, done, budgets, hit_eos, presence,
+    )
+
+
+@functools.partial(
     jax.jit, static_argnames=("config", "mesh"), donate_argnums=(6,)
 )
 def paged_score_chunk(

@@ -395,6 +395,8 @@ class IterationLedger:
         self._block_s = 0.0
         self._idle_s = 0.0
         self._host_s = {p: 0.0 for p in self.HOST_PHASES}
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._first_start: Optional[float] = None
         self._last_end: Optional[float] = None
 
@@ -412,6 +414,8 @@ class IterationLedger:
         cohort: int = 0,
         queue_depth: int = 0,
         pages_in_use: int = 0,
+        spec_proposed: int = 0,
+        spec_accepted: int = 0,
     ) -> Dict[str, Any]:
         # ``device_s`` is the legacy fused bracket around a blocking inner
         # call; callers that time async dispatch separately pass
@@ -439,11 +443,15 @@ class IterationLedger:
             "cohort": int(cohort),
             "queue_depth": int(queue_depth),
             "pages_in_use": int(pages_in_use),
+            "spec_proposed": int(spec_proposed),
+            "spec_accepted": int(spec_accepted),
         }
         with self._lock:
             self._iterations += 1
             row["iteration"] = self._iterations
             self._tokens += int(tokens)
+            self._spec_proposed += int(spec_proposed)
+            self._spec_accepted += int(spec_accepted)
             self._device_s += max(0.0, device_s)
             self._dispatch_s += dispatch_s
             self._block_s += block_s
@@ -473,6 +481,8 @@ class IterationLedger:
             block_s = self._block_s
             idle_s = self._idle_s
             host = dict(self._host_s)
+            spec_proposed = self._spec_proposed
+            spec_accepted = self._spec_accepted
             first = self._first_start
             last = self._last_end
         host_s = sum(host.values())
@@ -500,6 +510,13 @@ class IterationLedger:
             "host_breakdown": {k: round(v, 6) for k, v in host.items()},
             "coverage": round(accounted / denom, 4),
             "tokens_per_device_s": round(tokens / device_s, 2) if device_s > 0 else 0.0,
+            # Speculative decode attribution: drafts proposed vs accepted
+            # across every recorded iteration (0/0 when spec decode is off).
+            "draft_proposed_tokens": spec_proposed,
+            "draft_accepted_tokens": spec_accepted,
+            "draft_acceptance_rate": round(
+                spec_accepted / spec_proposed, 4
+            ) if spec_proposed else 0.0,
             # The split is only meaningful under real async dispatch: on the
             # CPU backend the "device" executes host-synchronously, so
             # block_s contains the device compute itself and

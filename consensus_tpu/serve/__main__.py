@@ -70,6 +70,19 @@ def main(argv=None) -> int:
     parser.add_argument("--engine-options", default="{}",
                         help="JSON object of DecodeEngine kwargs (e.g. "
                              '\'{"slots": 16, "page_size": 16}\')')
+    parser.add_argument("--decode-steps", type=int, default=None,
+                        metavar="K",
+                        help="multi-token decode: the engine dispatches "
+                             "K-step on-device decode windows per cohort "
+                             "(shorthand for --engine-options "
+                             '\'{"decode_steps": K}\')')
+    parser.add_argument("--speculative", action="store_true",
+                        help="engine-native speculative decoding: each "
+                             "decode window drafts K tokens per row (n-gram "
+                             "self-draft) and verifies them in one dispatch, "
+                             "emitting 1 + accepted real tokens; output "
+                             "stays byte-identical (shorthand for "
+                             '--engine-options \'{"speculative": true}\')')
     parser.add_argument("--fleet", type=int, default=1, metavar="N",
                         help="run N backend replicas behind the fleet "
                              "router (health-gated routing, scenario "
@@ -137,6 +150,12 @@ def main(argv=None) -> int:
     if args.blackbox:
         get_flight_recorder().configure(args.blackbox)
 
+    engine_options = json.loads(args.engine_options) or {}
+    if args.decode_steps is not None:
+        engine_options.setdefault("decode_steps", args.decode_steps)
+    if args.speculative:
+        engine_options.setdefault("speculative", True)
+
     fleet_options = json.loads(args.fleet_options) or {}
     if args.elastic or args.autoscale:
         fleet_options.setdefault("elastic", True)
@@ -159,7 +178,7 @@ def main(argv=None) -> int:
         brownout=args.brownout or args.target_p95_ms is not None,
         target_p95_ms=args.target_p95_ms,
         engine=args.engine,
-        engine_options=json.loads(args.engine_options),
+        engine_options=engine_options or None,
         fleet_size=args.fleet,
         fleet_options=fleet_options or None,
         mesh=args.mesh,
@@ -188,6 +207,7 @@ def main(argv=None) -> int:
         "max_inflight": args.max_inflight,
         "brownout": args.brownout or args.target_p95_ms is not None,
         "engine": args.engine,
+        "speculative": bool(engine_options.get("speculative")),
         "fleet": args.fleet,
         "elastic": bool(fleet_options.get("elastic")
                         or fleet_options.get("autoscale")),

@@ -245,6 +245,7 @@ def run_loadgen(
 
     fleet_before = fetch_fleet_stats(base_url)
     prefix_before = fetch_prefix_stats(base_url)
+    spec_before = fetch_speculative_stats(base_url)
     threads: List[threading.Thread] = []
     start_wall = time.perf_counter()
     for i, payload in enumerate(payloads):
@@ -432,6 +433,34 @@ def run_loadgen(
         report["prefix_hit_fraction"] = (
             round(hits / (hits + misses), 4) if (hits + misses) else 0.0
         )
+    spec_after = fetch_speculative_stats(base_url)
+    if spec_after is not None:
+        # Speculative-decode effectiveness over THIS run: draft
+        # proposed/accepted deltas across every engine behind the server.
+        before = spec_before or {}
+        proposed = (
+            spec_after.get("proposed_tokens", 0)
+            - before.get("proposed_tokens", 0)
+        )
+        accepted = (
+            spec_after.get("accepted_tokens", 0)
+            - before.get("accepted_tokens", 0)
+        )
+        windows = (
+            spec_after.get("decode_windows", 0)
+            - before.get("decode_windows", 0)
+        )
+        report["speculative"] = {
+            "proposed_tokens": proposed,
+            "accepted_tokens": accepted,
+            "decode_windows": windows,
+            "accepted_tokens_per_dispatch": (
+                round(accepted / windows, 4) if windows else 0.0
+            ),
+            "draft_acceptance_rate": (
+                round(accepted / proposed, 4) if proposed else 0.0
+            ),
+        }
     return report
 
 
@@ -508,6 +537,46 @@ def fetch_prefix_stats(base_url: str) -> Optional[Dict[str, float]]:
     for key in ("hits", "misses", "evictions", "inserted_pages",
                 "tokens_saved"):
         totals[key] = sum(b.get(key, 0) for b in blocks)
+    return totals
+
+
+def fetch_speculative_stats(base_url: str) -> Optional[Dict[str, float]]:
+    """Summed speculative-decode counters across every engine behind the
+    server's /healthz — the single scheduler's ``engine`` block, or each
+    fleet replica's.  None when no engine has speculative decoding on (or
+    /healthz is down)."""
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/healthz", timeout=5.0
+        ) as response:
+            health = json.loads(response.read().decode("utf-8"))
+    except Exception:
+        return None
+    engines = []
+    engine = health.get("engine")
+    if isinstance(engine, dict):
+        engines.append(engine)
+    fleet = health.get("fleet")
+    if isinstance(fleet, dict):
+        for snap in (fleet.get("replicas") or {}).values():
+            if isinstance(snap, dict) and isinstance(
+                snap.get("engine"), dict
+            ):
+                engines.append(snap["engine"])
+    engines = [
+        e for e in engines
+        if isinstance(e.get("speculative"), dict)
+        and e["speculative"].get("enabled")
+    ]
+    if not engines:
+        return None
+    totals: Dict[str, float] = {
+        key: sum(e["speculative"].get(key, 0) for e in engines)
+        for key in ("proposed_tokens", "accepted_tokens")
+    }
+    totals["decode_windows"] = sum(
+        e.get("decode_windows", 0) for e in engines
+    )
     return totals
 
 

@@ -54,6 +54,10 @@ DEFAULT_GATES: List[Tuple[str, str, float]] = [
     ("extra.padding_efficiency", "higher", 0.3),
     ("extra.engine_padding_efficiency", "higher", 0.3),
     ("extra.bench_obs.throughput_on_rps", "higher", 0.5),
+    ("extra.spec_statements_per_sec", "higher", 0.5),
+    ("extra.spec_k1_tokens_per_dispatch", "higher", 0.2),
+    ("extra.spec_stream_cells.k1_spec.draft_acceptance_rate",
+     "higher", 0.5),
 ]
 
 

@@ -146,6 +146,14 @@ def main(argv=None) -> int:
                              "windows per cohort instead of one blocking "
                              "call per token (implies --engine; shorthand "
                              'for --engine-options \'{"decode_steps": K}\')')
+    parser.add_argument("--speculative", action="store_true",
+                        help="(self-contained) engine-native speculative "
+                             "decoding: each decode window drafts K tokens "
+                             "per row (n-gram self-draft) and verifies them "
+                             "in one dispatch, emitting 1 + accepted real "
+                             "tokens (implies --engine; shorthand for "
+                             '--engine-options \'{"speculative": true}\'; '
+                             "output stays byte-identical)")
     parser.add_argument("--mesh", default=None, metavar="dp=N,tp=M",
                         help="(self-contained) serve over the (data, model) "
                              "device mesh: the decode engine partitions its "
@@ -254,6 +262,8 @@ def main(argv=None) -> int:
             engine_options.setdefault("prefix_cache", True)
         if args.decode_steps is not None:
             engine_options.setdefault("decode_steps", args.decode_steps)
+        if args.speculative:
+            engine_options.setdefault("speculative", True)
         fleet_options = json.loads(args.fleet_options) or {}
         if args.elastic or args.autoscale:
             fleet_options.setdefault("elastic", True)

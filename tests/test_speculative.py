@@ -187,3 +187,431 @@ def test_fallback_session_accepts_speculative_flag():
         "mcts", FakeBackend(), {**cfg, "speculative_rollouts": True}
     ).generate_statement(ISSUE, OPINIONS)
     assert spec == plain
+
+
+# ---------------------------------------------------------------------------
+# Engine-native speculative decoding: draft-and-verify in the K-step
+# serving window (``engine_options={"speculative": true}``)
+# ---------------------------------------------------------------------------
+
+#: Same small-but-real per-method params the engine byte-identity matrix
+#: in test_engine.py uses (kept in sync by eye — any drift fails both).
+ENGINE_METHOD_PARAMS = {
+    "zero_shot": {"seed": 42, "max_tokens": 30},
+    "predefined": {"predefined_statement": "Exactly this statement."},
+    "best_of_n": {"num_best_of_n": 4, "seed": 7, "max_tokens": 24},
+    "beam_search": {"beam_width": 2, "max_tokens": 6, "seed": 5},
+    "finite_lookahead": {
+        "branching_factor": 2, "max_depth": 2, "max_tokens": 5, "seed": 9,
+    },
+    "mcts": {
+        "num_simulations": 4, "expansion_sample_width": 3, "max_tokens": 4,
+        "rollout_depth": 3, "seed": 2,
+    },
+    "habermas_machine": {
+        "num_candidates": 3, "num_rounds": 1, "seed": 42, "max_tokens": 64,
+    },
+}
+
+ENGINE_ISSUE = "Should the city invest in more bike lanes?"
+ENGINE_OPINIONS = {
+    "Agent 1": "Bike lanes make streets safer and should be expanded.",
+    "Agent 2": "Road space is scarce; cars and buses need priority.",
+    "Agent 3": "Invest only where cycling demand is proven.",
+}
+
+
+class TestEngineSpecByteIdentity:
+    """Speculative decoding must be invisible in engine results: spec-on
+    == spec-off == legacy solo for every method and every K (spec-off ==
+    solo is already pinned by the PR 15 matrix; this anchors spec-on to
+    the same solo baseline)."""
+
+    @pytest.mark.parametrize("method", sorted(ENGINE_METHOD_PARAMS))
+    def test_spec_engine_matches_legacy_all_methods(self, method):
+        from consensus_tpu.backends.batching import BatchingBackend
+        from consensus_tpu.backends.fake import FakeBackend
+        from consensus_tpu.methods import get_method_generator
+
+        params = ENGINE_METHOD_PARAMS[method]
+        solo = get_method_generator(
+            method, FakeBackend(), dict(params)
+        ).generate_statement(ENGINE_ISSUE, ENGINE_OPINIONS)
+
+        for k in (1, 4, 8):
+            engined = BatchingBackend(
+                FakeBackend(), engine=True,
+                engine_options={"slots": 4, "num_pages": 512,
+                                "decode_steps": k, "speculative": True},
+            )
+            try:
+                via_engine = get_method_generator(
+                    method, engined, dict(params)
+                ).generate_statement(ENGINE_ISSUE, ENGINE_OPINIONS)
+                stats = engined.engine.stats()
+            finally:
+                engined.close()
+            assert via_engine == solo, f"{method}: spec K={k} diverged"
+            spec = stats["speculative"]
+            assert spec["enabled"]
+            assert spec["proposed_tokens"] >= spec["accepted_tokens"] >= 0
+
+    def test_spec_engine_exports_draft_counters(self):
+        from consensus_tpu.backends.batching import BatchingBackend
+        from consensus_tpu.backends.fake import FakeBackend
+        from consensus_tpu.methods import get_method_generator
+        from consensus_tpu.obs.metrics import diff_snapshots
+
+        inner = FakeBackend()
+        before = inner.instruments.registry.snapshot()
+        engined = BatchingBackend(
+            inner, engine=True,
+            engine_options={"slots": 4, "num_pages": 512,
+                            "decode_steps": 4, "speculative": True},
+        )
+        try:
+            get_method_generator(
+                "zero_shot", engined, {"seed": 42, "max_tokens": 30}
+            ).generate_statement(ENGINE_ISSUE, ENGINE_OPINIONS)
+            stats = engined.engine.stats()
+        finally:
+            engined.close()
+        delta = diff_snapshots(before, inner.instruments.registry.snapshot())
+
+        def total(name):
+            family = (delta.get("families") or {}).get(name) or {}
+            return sum(s.get("value", 0) for s in family.get("series", []))
+
+        proposed = total("spec_draft_proposed_tokens_total")
+        verified = total("spec_draft_verified_tokens_total")
+        assert proposed > 0
+        assert 0 <= verified <= proposed
+        # The engine's stats aggregate the same stream counters.
+        assert stats["speculative"]["proposed_tokens"] == proposed
+        assert stats["speculative"]["accepted_tokens"] == verified
+        # Ledger attribution mirrors the totals.
+        mfu = stats["mfu_attribution"]
+        assert mfu["draft_proposed_tokens"] == proposed
+        assert mfu["draft_accepted_tokens"] == verified
+
+
+def _drain_stream(stream):
+    """Drive a generate stream to completion; returns (results, windows)."""
+    results, windows = {}, 0
+    while not stream.finished:
+        stream.dispatch()
+        _, finished = stream.collect()
+        results.update(finished)
+        windows += 1
+        assert windows < 200, "stream failed to drain"
+    stream.close()
+    return results, windows
+
+
+class TestSpecStreamTPU:
+    """The speculative serving stream on the tiny real model: accepted
+    prefixes and corrections must reproduce the sequential scan's sampling
+    decisions bit-for-bit."""
+
+    COHORT = (
+        ("Say something about apples.", 11, 12, 0.8),
+        ("Hi", 22, 5, 0.0),
+        ("A longer prompt that should span several pages of the stream "
+         "pool for testing purposes.", 33, 20, 0.9),
+    )
+
+    def _requests(self):
+        from consensus_tpu.backends.base import GenerationRequest
+
+        return [
+            GenerationRequest(
+                user_prompt=prompt, seed=seed, max_tokens=mt, temperature=t,
+            )
+            for prompt, seed, mt, t in self.COHORT
+        ]
+
+    def test_spec_stream_byte_identical_to_legacy(self, backend):
+        legacy = backend.generate(self._requests())
+        for k in (1, 4):
+            stream = backend.generate_stream(
+                self._requests(), decode_steps=k, speculative=True,
+            )
+            results, _ = _drain_stream(stream)
+            got = [
+                (results[i].text, results[i].token_ids,
+                 results[i].finish_reason)
+                for i in range(len(self.COHORT))
+            ]
+            assert got == [
+                (r.text, r.token_ids, r.finish_reason) for r in legacy
+            ], f"spec stream K={k} diverged from legacy"
+
+    def test_accepted_prefix_and_correction_exact(self, backend):
+        """A greedy row on a self-similar prompt accepts drafts (the
+        n-gram proposer replays the repetition) — and the output is STILL
+        byte-identical: both the accepted prefix and the post-rejection
+        correction token replay the sequential decisions exactly."""
+        from consensus_tpu.backends.base import GenerationRequest
+
+        req = lambda: [GenerationRequest(  # noqa: E731
+            user_prompt="one two three one two three one two three "
+                        "one two three",
+            seed=1, max_tokens=40, temperature=0.0,
+        )]
+        legacy = backend.generate(req())
+        stream = backend.generate_stream(
+            req(), decode_steps=4, speculative=True,
+        )
+        results, windows = _drain_stream(stream)
+        got = results[0]
+        assert (got.text, got.token_ids, got.finish_reason) == (
+            legacy[0].text, legacy[0].token_ids, legacy[0].finish_reason
+        )
+        # Acceptance did real work: each window consumes 1 + accepted
+        # sequential decisions, so accepted drafts shave exactly that many
+        # dispatches off the 41-decision budget (40 emits + eos-check).
+        assert stream.spec_accepted > 0
+        assert stream.spec_proposed >= stream.spec_accepted
+        assert windows <= 41 - stream.spec_accepted + 1
+
+    def test_eos_inside_accepted_draft_freezes_row(self, backend):
+        """A row that samples EOS mid-window freezes there: the result
+        matches the sequential truncation, and once the row is done every
+        later window's writes land in the sink — its pool pages stay
+        byte-identical while a co-resident row keeps decoding."""
+        import numpy as np
+
+        from consensus_tpu.backends.base import GenerationRequest
+
+        probe = _drain_stream(
+            backend.generate_stream(
+                [GenerationRequest(
+                    user_prompt="freeze me", seed=5, max_tokens=8,
+                    temperature=0.0,
+                )],
+                decode_steps=1,
+            )
+        )[0][0]
+        assert len(probe.token_ids) == 8
+        # Declare EOS the first continuation token that has no earlier
+        # occurrence (an earlier repeat would truncate the probe itself).
+        cut = next(
+            (t for t in (2, 3, 4, 5, 6, 1)
+             if probe.token_ids[t] not in probe.token_ids[:t]),
+            None,
+        )
+        if cut is None:
+            pytest.skip("greedy continuation repeats every candidate EOS")
+        eos_token = probe.token_ids[cut]
+
+        requests = [
+            GenerationRequest(
+                user_prompt="freeze me", seed=5, max_tokens=8,
+                temperature=0.0,
+            ),
+            GenerationRequest(
+                user_prompt="keep decoding for a good while longer",
+                seed=77, max_tokens=24, temperature=0.9,
+            ),
+        ]
+        original_eos = backend.tokenizer.eos_ids
+        backend.tokenizer.eos_ids = (int(eos_token),)
+        try:
+            stream = backend.generate_stream(
+                requests, decode_steps=4, speculative=True,
+            )
+            tables = np.asarray(stream._tables)
+            row0_pages = [int(p) for p in tables[0] if p >= 0]
+            results, frozen_snapshot = {}, None
+            windows = 0
+            while not stream.finished:
+                stream.dispatch()
+                _, finished = stream.collect()
+                results.update(finished)
+                windows += 1
+                assert windows < 200
+                if 0 in results and frozen_snapshot is None:
+                    frozen_snapshot = np.asarray(
+                        stream._state.k_pages[:, row0_pages]
+                    ).copy()
+                    frozen_len = int(np.asarray(stream._lengths)[0])
+            final_pages = np.asarray(stream._state.k_pages[:, row0_pages])
+            final_len = int(np.asarray(stream._lengths)[0])
+            stream.close()
+        finally:
+            backend.tokenizer.eos_ids = original_eos
+
+        assert results[0].finish_reason == "stop"
+        assert results[0].token_ids == probe.token_ids[:cut]
+        assert 1 in results  # the co-resident row drained too
+        # Row 0 froze before the stream did (its EOS came early)...
+        assert frozen_snapshot is not None
+        assert final_len == frozen_len
+        # ...and every post-freeze window wrote its row-0 columns to the
+        # sink: the row's pool pages never changed again.
+        np.testing.assert_array_equal(frozen_snapshot, final_pages)
+
+    def test_dp4_matches_dp1_through_spec_stream(self):
+        """Sharding the spec stream's slot axis over data must not change
+        a single emitted token (conftest provides 8 virtual CPU devices)."""
+        from consensus_tpu.backends.base import GenerationRequest
+
+        def run(dp):
+            be = TPUBackend(
+                model="tiny-gemma2", max_context=128, base_seed=7, dp=dp,
+            )
+            requests = [
+                GenerationRequest(
+                    user_prompt=f"device parallel prompt {i}", seed=100 + i,
+                    max_tokens=6 + i, temperature=0.7,
+                )
+                for i in range(4)
+            ]
+            results = _drain_stream(
+                be.generate_stream(
+                    requests, decode_steps=4, speculative=True,
+                )
+            )[0]
+            return [
+                (results[i].text, results[i].token_ids,
+                 results[i].finish_reason)
+                for i in range(4)
+            ]
+
+        assert run(1) == run(4)
+
+
+class TestVerifyKernelPageBoundary:
+    """Kernel-level write discipline: a fully-accepted verify window that
+    crosses a page boundary writes only pages the cursors name — rows
+    adopting shared prefix pages leave the shared bytes untouched."""
+
+    def test_accepted_window_crosses_boundary_spares_shared_pages(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from consensus_tpu.models import stepper
+        from consensus_tpu.models.config import get_model_config
+        from consensus_tpu.models.transformer import (
+            init_params,
+            project_logits,
+        )
+
+        cfg = get_model_config("tiny-gemma2")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(1, cfg.vocab_size, size=(8,)).astype(np.int32)
+        page_size, max_blocks = 4, 8
+        # Pages: 0-1 shared prompt, 2-3 row0 private, 4-5 row1 private.
+        num_pages, sink = 6, 6
+
+        def prefill():
+            state = stepper.make_page_state(
+                cfg, num_pages, page_size, jnp.float32
+            )
+            tables = np.full((2, max_blocks), -1, np.int32)
+            tables[0, :4] = [0, 1, 2, 3]
+            tables[1, :4] = [0, 1, 4, 5]  # adopts the shared prompt pages
+            tok = np.zeros((2, 8), np.int32)
+            cval = np.zeros((2, 8), bool)
+            wp = np.full((2, 8), sink, np.int32)
+            wo = np.zeros((2, 8), np.int32)
+            tok[0] = prompt
+            cval[0] = True
+            for t in range(8):
+                wp[0, t] = t // page_size
+                wo[0, t] = t % page_size
+            hidden, state = stepper.paged_prefill_chunk(
+                params, cfg, jnp.asarray(tok), jnp.asarray(cval), state,
+                jnp.asarray(tables), jnp.asarray([8, 0], np.int32),
+                jnp.asarray(wp), jnp.asarray(wo),
+            )
+            logits0 = project_logits(params, cfg, hidden)
+            logits = jnp.stack([logits0[0], logits0[0]])
+            return state, jnp.asarray(tables), logits
+
+        # Sequential ground truth: 6 greedy tokens through the K-step scan
+        # (state donated, so prefill fresh for the verify run below).
+        state, tables, logits = prefill()
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray([1, 2], jnp.uint32))
+        seq = stepper.paged_decode_steps(
+            params, cfg, logits, state, tables,
+            jnp.asarray([8, 8], np.int32), keys,
+            jnp.zeros(2, bool), jnp.asarray([6, 6], np.int32),
+            jnp.zeros(2, bool),
+            temperature=jnp.zeros(2, jnp.float32), num_steps=8,
+        )
+        greedy = np.asarray(seq[0])[0][np.asarray(seq[1])[0]].tolist()
+        assert len(greedy) == 6
+
+        # Verify window 1 (no pending): a PERFECT K=4 draft — the window
+        # accepts all 4 and emits the bonus token, crossing the page-2
+        # boundary (length 8 -> 12) in one dispatch.
+        state, tables, logits = prefill()
+        shared_before = np.asarray(state.k_pages[:, :2]).copy()
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray([1, 2], jnp.uint32))
+        drafts = jnp.asarray(
+            np.stack([greedy[:4], greedy[:4]]).astype(np.int32)
+        )
+        out = stepper.paged_verify_steps(
+            params, cfg, logits, state, tables,
+            jnp.asarray([8, 8], np.int32), keys,
+            jnp.zeros(2, bool), jnp.asarray([6, 6], np.int32),
+            jnp.zeros(2, bool),
+            temperature=jnp.zeros(2, jnp.float32),
+            draft_tokens=drafts,
+            pending=jnp.zeros(2, jnp.int32),
+            num_steps=4, has_pending=False,
+        )
+        (tokens, emitted, accepted, pending, state, lengths, keys, done,
+         budgets, hit_eos, _) = out
+        np.testing.assert_array_equal(np.asarray(tokens)[0], greedy[:5])
+        np.testing.assert_array_equal(np.asarray(emitted), True)
+        np.testing.assert_array_equal(np.asarray(accepted), [4, 4])
+        np.testing.assert_array_equal(np.asarray(pending), [greedy[4]] * 2)
+        np.testing.assert_array_equal(np.asarray(lengths), [12, 12])
+        np.testing.assert_array_equal(np.asarray(done), [False, False])
+
+        # Verify window 2 (pending column): one budgeted token left — the
+        # pending K/V lands, the last token emits, the row retires.
+        out = stepper.paged_verify_steps(
+            params, cfg, None, state, tables, lengths, keys, done,
+            budgets, hit_eos,
+            temperature=jnp.zeros(2, jnp.float32),
+            draft_tokens=drafts, pending=pending,
+            num_steps=4, has_pending=True,
+        )
+        (tokens, emitted, accepted, pending, state, lengths, keys, done,
+         budgets, hit_eos, _) = out
+        tokens, emitted = np.asarray(tokens), np.asarray(emitted)
+        assert tokens[0][emitted[0]].tolist() == [greedy[5]]
+        assert emitted.sum(axis=1).tolist() == [1, 1]
+        if not bool(np.asarray(done)[0]):
+            # The stale draft column missed, so the row's decision chain
+            # ended on the budget-spending emit: the eos-check (the 41st
+            # sequential split, which latches done) lands at the NEXT
+            # window's first decision — exactly like the sequential scan's
+            # one extra sample at budgets == 0.
+            np.testing.assert_array_equal(np.asarray(lengths), [13, 13])
+            out = stepper.paged_verify_steps(
+                params, cfg, None, state, tables, lengths, keys, done,
+                budgets, hit_eos,
+                temperature=jnp.zeros(2, jnp.float32),
+                draft_tokens=drafts, pending=pending,
+                num_steps=4, has_pending=True,
+            )
+            (tokens, emitted, accepted, pending, state, lengths, keys,
+             done, budgets, hit_eos, _) = out
+            assert np.asarray(emitted).sum() == 0
+        # Either way both rows land done at length 14: prompt 8 + the
+        # 6-token budget, every emitted token's K/V written exactly once.
+        np.testing.assert_array_equal(np.asarray(lengths), [14, 14])
+        np.testing.assert_array_equal(np.asarray(done), [True, True])
+
+        # Shared prompt pages: byte-identical after both windows; the two
+        # rows' private continuation K/V bytes match (same tokens, same
+        # positions, own pages).
+        kp = np.asarray(state.k_pages)
+        np.testing.assert_array_equal(shared_before, kp[:, :2])
+        np.testing.assert_array_equal(kp[:, 2:4], kp[:, 4:6])
