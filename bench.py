@@ -749,6 +749,65 @@ def main() -> None:
                              "streams) is the part pinned in CI",
             })
 
+    # ---- corpus-driven load cell (PR 18) -----------------------------
+    # The serve + prefix cells above replay the 4 AAMAS scenarios; this
+    # cell drives the versioned scenario corpus (data/scenarios_v2)
+    # through the same engine-backed serve stack with a weighted family
+    # mix, and pins the headline fairness number: the egalitarian price
+    # of utilitarian selection on the 500-agent polarized scenario
+    # (mean_prob channel — the same table tests/golden/fairness pins).
+    # BENCH_CORPUS=0 skips; BENCH_CORPUS_REQUESTS / BENCH_CORPUS_RATE /
+    # BENCH_CORPUS_MIX rescale.
+    corpus_extra = {}
+    if os.environ.get("BENCH_CORPUS", "1") != "0":
+        from consensus_tpu.backends.fake import FakeBackend
+        from consensus_tpu.data.scenarios.fairness import welfare_gap_table
+        from consensus_tpu.data.scenarios.registry import (
+            resolve_scenario_ref,
+        )
+        from consensus_tpu.obs.metrics import Registry
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import corpus_requests, run_loadgen
+
+        corpus_count = int(os.environ.get("BENCH_CORPUS_REQUESTS", "24"))
+        corpus_rate = float(os.environ.get("BENCH_CORPUS_RATE", "50"))
+        corpus_mix = os.environ.get(
+            "BENCH_CORPUS_MIX", "polarized=2,sybil=1,holdout=1")
+        corpus_payloads = corpus_requests(
+            "v2", corpus_count,
+            params={"n": 8, "max_tokens": NEW_TOKENS}, mix=corpus_mix,
+        )
+        server = create_server(
+            backend="fake", port=0, max_inflight=4, engine=True,
+            engine_options={
+                "slots": 4, "num_pages": 4096, "prefix_cache": True},
+            registry=Registry(),
+        ).start()
+        try:
+            corpus_report = run_loadgen(
+                server.base_url, corpus_payloads, rate_rps=corpus_rate)
+        finally:
+            server.stop()
+        gap_table = welfare_gap_table(
+            FakeBackend(), resolve_scenario_ref("corpus:v2:polarized-500"),
+            n_candidates=6, max_tokens=16, seed=0,
+        )
+        gaps = gap_table["channels"]["mean_prob"]["gaps"]
+        corpus_extra = {
+            "corpus_requests": corpus_count,
+            "corpus_scenario_mix": corpus_report["scenario_mix"],
+            "corpus_statements_per_sec": corpus_report["throughput_rps"],
+            "corpus_prefix_hit_fraction": corpus_report.get(
+                "prefix_hit_fraction"),
+            "corpus_availability": corpus_report["availability"],
+            "welfare_gap_polarized": gaps[
+                "egalitarian_price_of_utilitarian"],
+            "welfare_gap_note": "egalitarian welfare forfeited by the "
+                                "utilitarian winner on corpus:v2:"
+                                "polarized-500 (mean_prob channel; fake "
+                                "backend — the fairness-suite golden)",
+        }
+
     # ---- BENCH_MESH: dp scaling of the mesh serving path -----------------
     # Statements/sec efficiency of the engine partitioned over a dp=4 mesh
     # vs one device, plus the two identity invariants (dp=1 byte-identical
@@ -1396,6 +1455,7 @@ def main() -> None:
                     **brownout_extra,
                     **fleet_extra,
                     **prefix_extra,
+                    **corpus_extra,
                     **mesh_extra,
                     **score_extra,
                     **elastic_extra,

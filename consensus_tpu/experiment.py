@@ -92,7 +92,21 @@ class Experiment:
         self.base_seed = int(config.get("seed", 42))
         self.num_seeds = int(config.get("num_seeds", 1))
 
-        scenario = config.get("scenario", {})
+        # ``scenario`` is inline issue/opinions (the historical form), a
+        # registry ref string ("corpus:v2:polarized-0004", "aamas:3"), or
+        # a dict with a ``ref`` key plus overriding fields — so sweep
+        # configs can name corpus scenarios instead of inlining text.
+        scenario_cfg = config.get("scenario", {})
+        if isinstance(scenario_cfg, str) or (
+            isinstance(scenario_cfg, dict) and "ref" in scenario_cfg
+        ):
+            from consensus_tpu.data.scenarios.registry import (
+                maybe_resolve_scenario,
+            )
+
+            scenario = maybe_resolve_scenario(scenario_cfg)
+        else:
+            scenario = scenario_cfg
         self.issue: str = scenario.get("issue", "")
         self.agent_opinions: Dict[str, str] = dict(scenario.get("agent_opinions", {}))
 

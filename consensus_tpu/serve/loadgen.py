@@ -15,6 +15,15 @@ fixed-k rotation) — the regime where the engine's prefix KV cache pays —
 and the report then carries ``prefix_hit_fraction`` read from the
 server's /healthz engine stats.  Stdlib only (``urllib``), like the
 front end.
+
+Alternatively :func:`corpus_requests` drives load from a scenario corpus
+(``consensus_tpu/data/scenarios``): weighted per-family sampling with a
+deterministic per-request assignment — the honest-diversity workload.
+Whichever builder produced the payloads, the report stamps the
+scenario-mix provenance (``round_robin:aamas`` / ``fixed:K`` /
+``zipf:S`` / ``corpus:v2[:mix]``) as ``scenario_mix`` right next to
+``prefix_hit_fraction``, so a repetition-artifact cache number can never
+be read as a workload property.
 """
 
 from __future__ import annotations
@@ -30,6 +39,20 @@ from typing import Any, Dict, List, Optional
 
 from consensus_tpu.data.aamas_scenarios import SCENARIOS
 from consensus_tpu.obs.trace import RollingWindow
+
+
+class Workload(list):
+    """A payload list that remembers how its scenario mix was built, so
+    :func:`run_loadgen` can stamp provenance on the report without the
+    caller re-plumbing it."""
+
+    provenance: str = "unspecified"
+
+    @classmethod
+    def with_provenance(cls, payloads, provenance: str) -> "Workload":
+        workload = cls(payloads)
+        workload.provenance = provenance
+        return workload
 
 
 def _scenario_sequence(
@@ -126,7 +149,61 @@ def scenario_requests(
         if timeout_s is not None:
             payload["timeout_s"] = timeout_s
         payloads.append(payload)
-    return payloads
+    provenance = (
+        "round_robin:aamas" if scenario_repeat is None
+        else str(scenario_repeat)
+    )
+    return Workload.with_provenance(payloads, provenance)
+
+
+def corpus_requests(
+    corpus,
+    count: int,
+    method: str = "best_of_n",
+    params: Optional[Dict[str, Any]] = None,
+    base_seed: int = 100,
+    evaluate: bool = False,
+    timeout_s: Optional[float] = None,
+    mix: Optional[str] = None,
+    agents: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """``count`` request payloads drawn from a scenario corpus.
+
+    ``corpus`` is a loaded :class:`~consensus_tpu.data.scenarios.Corpus`
+    or a name/path resolvable by the scenario registry (``"v2"`` →
+    ``data/scenarios_v2``).  ``mix`` is an optional per-family weighting
+    (``"polarized=2,sybil=1"``); assignment is deterministic in
+    (corpus, mix, count, base_seed) — see ``Corpus.sample_sequence``.
+    Each request's id carries its scenario id
+    (``loadgen-<i>:<scenario_id>``) so reports and traces can attribute
+    outcomes per family.  ``agents`` force-expands every scenario to a
+    fixed panel size, like :func:`scenario_requests`."""
+    if isinstance(corpus, str):
+        from consensus_tpu.data.scenarios.registry import get_corpus
+
+        corpus = get_corpus(corpus)
+    order = corpus.sample_sequence(count, mix=mix, base_seed=base_seed)
+    payloads = []
+    for i, scenario in enumerate(order):
+        opinions = dict(scenario["agent_opinions"])
+        if agents is not None:
+            opinions = _expand_agents(opinions, int(agents))
+        payload: Dict[str, Any] = {
+            "issue": scenario["issue"],
+            "agent_opinions": opinions,
+            "method": method,
+            "params": dict(params or {}),
+            "seed": base_seed + i,
+            "evaluate": evaluate,
+            "request_id": f"loadgen-{i}:{scenario['id']}",
+        }
+        if timeout_s is not None:
+            payload["timeout_s"] = timeout_s
+        payloads.append(payload)
+    provenance = f"corpus:{corpus.version or corpus.name}"
+    if mix:
+        provenance += f":{mix}"
+    return Workload.with_provenance(payloads, provenance)
 
 
 @dataclasses.dataclass
@@ -169,6 +246,7 @@ def run_loadgen(
     client_timeout_s: float = 60.0,
     curve_bucket_s: Optional[float] = None,
     include_slo: bool = False,
+    scenario_mix: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Replay ``payloads`` open-loop at ``rate_rps`` against ``base_url``.
 
@@ -413,6 +491,15 @@ def run_loadgen(
             shard.get("slots_occupied", 0)
             for shard in mesh_stats.get("per_shard", [])
         ]
+    # Scenario-mix provenance rides NEXT TO prefix_hit_fraction: a
+    # prefix-cache number from `fixed:2` repetition and one from
+    # `corpus:v2` diversity are different claims, and the report says
+    # which one it is making.
+    report["scenario_mix"] = (
+        scenario_mix
+        if scenario_mix is not None
+        else getattr(payloads, "provenance", "unspecified")
+    )
     prefix_after = fetch_prefix_stats(base_url)
     if prefix_after is not None:
         # Prefix-cache effectiveness over THIS run: admission hit/miss
@@ -429,6 +516,7 @@ def run_loadgen(
             "hits": hits,
             "misses": misses,
             "tokens_saved": saved,
+            "scenario_mix": report["scenario_mix"],
         }
         report["prefix_hit_fraction"] = (
             round(hits / (hits + misses), 4) if (hits + misses) else 0.0

@@ -86,20 +86,45 @@ def parse_request(payload: Any) -> ConsensusRequest:
             [f"request body must be a JSON object, got {type(payload).__name__}"]
         )
 
-    issue = payload.get("issue")
-    if not isinstance(issue, str) or not issue.strip():
-        errors.append("'issue' must be a non-empty string")
+    # A scenario ref replaces inline issue/opinions: the request names a
+    # registry scenario (``aamas:3``, ``corpus:v2:polarized-500``) and the
+    # server resolves it — same text every client, no 75 KB payloads for
+    # the 500-agent scenarios.
+    scenario_ref = payload.get("scenario")
+    if scenario_ref is not None:
+        if "issue" in payload or "agent_opinions" in payload:
+            errors.append("'scenario' replaces 'issue'/'agent_opinions'; "
+                          "send one or the other")
+        if not isinstance(scenario_ref, str) or not scenario_ref.strip():
+            errors.append("'scenario' must be a ref string like "
+                          "'aamas:3' or 'corpus:v2:polarized-500'")
+            scenario_ref = None
 
-    opinions = payload.get("agent_opinions")
-    if not isinstance(opinions, dict) or not opinions:
-        errors.append("'agent_opinions' must be a non-empty object of "
-                      "{agent name: opinion text}")
-        opinions = {}
+    if scenario_ref is not None:
+        from consensus_tpu.data.scenarios.registry import resolve_scenario_ref
+
+        try:
+            resolved = resolve_scenario_ref(scenario_ref)
+            issue = resolved["issue"]
+            opinions = dict(resolved["agent_opinions"])
+        except (ValueError, KeyError, FileNotFoundError) as exc:
+            errors.append(f"'scenario': {exc}")
+            issue, opinions = "", {}
     else:
-        for name, text in opinions.items():
-            if not isinstance(text, str) or not text.strip():
-                errors.append(f"opinion for agent {name!r} must be a "
-                              "non-empty string")
+        issue = payload.get("issue")
+        if not isinstance(issue, str) or not issue.strip():
+            errors.append("'issue' must be a non-empty string")
+
+        opinions = payload.get("agent_opinions")
+        if not isinstance(opinions, dict) or not opinions:
+            errors.append("'agent_opinions' must be a non-empty object of "
+                          "{agent name: opinion text}")
+            opinions = {}
+        else:
+            for name, text in opinions.items():
+                if not isinstance(text, str) or not text.strip():
+                    errors.append(f"opinion for agent {name!r} must be a "
+                                  "non-empty string")
 
     method = payload.get("method")
     if not isinstance(method, str) or method not in GENERATOR_MAP:
@@ -167,8 +192,8 @@ def parse_request(payload: Any) -> ConsensusRequest:
 
     unknown = sorted(
         set(payload)
-        - {"issue", "agent_opinions", "method", "params", "seed", "evaluate",
-           "timeout_s", "request_id", "trace"}
+        - {"issue", "agent_opinions", "scenario", "method", "params", "seed",
+           "evaluate", "timeout_s", "request_id", "trace"}
     )
     if unknown:
         errors.append(f"unknown fields: {unknown}")

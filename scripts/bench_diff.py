@@ -58,6 +58,12 @@ DEFAULT_GATES: List[Tuple[str, str, float]] = [
     ("extra.spec_k1_tokens_per_dispatch", "higher", 0.2),
     ("extra.spec_stream_cells.k1_spec.draft_acceptance_rate",
      "higher", 0.5),
+    # Corpus-driven load (PR 18): throughput and cache hits may wobble on
+    # a loaded CI box; the welfare gap is a deterministic fake-backend
+    # golden, so ANY drift there is a real fairness regression.
+    ("extra.corpus_statements_per_sec", "higher", 0.5),
+    ("extra.corpus_prefix_hit_fraction", "higher", 0.3),
+    ("extra.welfare_gap_polarized", "equal", 0.001),
 ]
 
 
@@ -105,7 +111,11 @@ def gate_for(key: str, gates: List[Tuple[str, str, float]]):
 def adverse_change(
     old: float, new: float, direction: str
 ) -> Optional[float]:
-    """Relative change in the BAD direction (None when not adverse)."""
+    """Relative change in the BAD direction (None when not adverse).
+
+    ``direction`` is ``higher``/``lower`` (which way is better) or
+    ``equal`` for pinned values where drift in EITHER direction is a
+    regression (deterministic goldens surfaced through bench)."""
     if old == 0:
         return None  # no baseline to regress against
     rel = (new - old) / abs(old)
@@ -113,6 +123,8 @@ def adverse_change(
         return -rel
     if direction == "lower" and rel > 0:
         return rel
+    if direction == "equal" and rel != 0:
+        return abs(rel)
     return None
 
 
@@ -180,8 +192,10 @@ def main(argv=None) -> int:
         direction, bound = gate
         adverse = adverse_change(o, n, direction)
         if adverse is not None and adverse > bound:
+            expectation = ("pinned value" if direction == "equal"
+                           else f"{direction} is better")
             regressions.append(
-                f"{key}: {o} -> {n} ({direction} is better; adverse "
+                f"{key}: {o} -> {n} ({expectation}; adverse "
                 f"{adverse:.1%} > {bound:.0%} threshold)"
             )
             rows.append(f"  REGRESS!  {key}: {o} -> {n} (-{adverse:.1%})")

@@ -103,6 +103,17 @@ def main(argv=None) -> int:
     parser.add_argument("--params", default='{"n": 4, "max_tokens": 24}',
                         help="JSON object of method params")
     parser.add_argument("--seed", type=int, default=100)
+    parser.add_argument("--corpus", default=None, metavar="NAME[:MIX]",
+                        help="drive load from a scenario corpus instead "
+                             "of the 5 AAMAS scenarios: NAME resolves "
+                             "via the scenario registry ('v2' -> "
+                             "data/scenarios_v2, or a directory path); "
+                             "an optional :MIX weights families, e.g. "
+                             "'v2:polarized=2,sybil=1'.  Per-request "
+                             "assignment is deterministic in --seed, and "
+                             "the report's scenario_mix records "
+                             "'corpus:NAME[:MIX]' next to "
+                             "prefix_hit_fraction")
     parser.add_argument("--scenario-repeat", default=None, metavar="MIX",
                         help="scenario arrival mix: 'fixed:K' cycles the "
                              "first K scenarios, 'zipf:S' draws ranks with "
@@ -236,21 +247,39 @@ def main(argv=None) -> int:
         parser.error("exactly one of --url / --self-contained is required")
 
     from consensus_tpu.serve.loadgen import (
+        corpus_requests,
         report_json,
         run_loadgen,
         scenario_requests,
     )
 
-    payloads = scenario_requests(
-        args.requests,
-        method=args.method,
-        params=json.loads(args.params),
-        base_seed=args.seed,
-        evaluate=args.evaluate,
-        timeout_s=args.timeout_s,
-        scenario_repeat=args.scenario_repeat,
-        agents=args.agents,
-    )
+    if args.corpus is not None:
+        if args.scenario_repeat is not None:
+            parser.error("--corpus and --scenario-repeat are mutually "
+                         "exclusive scenario sources")
+        name, _, mix = args.corpus.partition(":")
+        payloads = corpus_requests(
+            name,
+            args.requests,
+            method=args.method,
+            params=json.loads(args.params),
+            base_seed=args.seed,
+            evaluate=args.evaluate,
+            timeout_s=args.timeout_s,
+            mix=mix or None,
+            agents=args.agents,
+        )
+    else:
+        payloads = scenario_requests(
+            args.requests,
+            method=args.method,
+            params=json.loads(args.params),
+            base_seed=args.seed,
+            evaluate=args.evaluate,
+            timeout_s=args.timeout_s,
+            scenario_repeat=args.scenario_repeat,
+            agents=args.agents,
+        )
 
     if args.self_contained:
         from consensus_tpu.obs import diff_snapshots, get_registry

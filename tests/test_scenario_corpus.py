@@ -1,0 +1,334 @@
+"""Scenario corpus subsystem (PR 18): generator determinism, manifest
+integrity, adversarial profile structure, registry refs, and the
+corpus-driven load generator."""
+
+import collections
+import json
+import pathlib
+
+import pytest
+
+from consensus_tpu.data.scenarios import (
+    FAMILIES,
+    CorpusSpec,
+    clear_corpus_cache,
+    corpus_root,
+    generate_scenarios,
+    load_corpus,
+    maybe_resolve_scenario,
+    parse_family_mix,
+    regenerate_check,
+    resolve_scenario_ref,
+    write_corpus,
+)
+from consensus_tpu.data.scenarios.corpus import (
+    CorpusIntegrityError,
+    content_hash,
+    family_stats,
+    scenarios_blob,
+)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+COMMITTED = REPO_ROOT / "data" / "scenarios_v2"
+
+TINY_SPEC = CorpusSpec(
+    version="vtest", seed=7, per_family=2, agent_ladder=(4, 6),
+    include_big=False,
+)
+
+
+# ---------------------------------------------------------------------------
+# Generator determinism (the property the corpus's versioning rests on)
+# ---------------------------------------------------------------------------
+
+
+class TestDeterminism:
+    def test_same_spec_regenerates_byte_identical_jsonl(self):
+        blob_a = scenarios_blob(generate_scenarios(TINY_SPEC))
+        blob_b = scenarios_blob(generate_scenarios(TINY_SPEC))
+        assert blob_a == blob_b
+        assert content_hash(blob_a) == content_hash(blob_b)
+
+    def test_seed_and_version_both_partition_the_stream(self):
+        base = scenarios_blob(generate_scenarios(TINY_SPEC))
+        other_seed = scenarios_blob(generate_scenarios(
+            CorpusSpec(version="vtest", seed=8, per_family=2,
+                       agent_ladder=(4, 6), include_big=False)))
+        other_version = scenarios_blob(generate_scenarios(
+            CorpusSpec(version="vtest2", seed=7, per_family=2,
+                       agent_ladder=(4, 6), include_big=False)))
+        assert base != other_seed
+        assert base != other_version
+
+    def test_write_then_check_round_trip(self, tmp_path):
+        write_corpus(tmp_path / "c", TINY_SPEC)
+        ok, detail = regenerate_check(tmp_path / "c")
+        assert ok, detail
+
+    def test_committed_corpus_regenerates_byte_identically(self):
+        ok, detail = regenerate_check(COMMITTED)
+        assert ok, detail
+
+    def test_tampered_jsonl_fails_verify(self, tmp_path):
+        write_corpus(tmp_path / "c", TINY_SPEC)
+        jsonl = tmp_path / "c" / "scenarios.jsonl"
+        lines = jsonl.read_text().splitlines()
+        record = json.loads(lines[0])
+        record["issue"] = "Tampered?"
+        lines[0] = json.dumps(record, sort_keys=True,
+                              separators=(",", ":"))
+        jsonl.write_text("\n".join(lines) + "\n")
+        with pytest.raises(CorpusIntegrityError):
+            load_corpus(tmp_path / "c")
+
+
+# ---------------------------------------------------------------------------
+# Profile structure: the manifest's per-family statistics are true of the
+# opinion text itself, not just of the profile metadata.
+# ---------------------------------------------------------------------------
+
+
+class TestProfiles:
+    @pytest.fixture(scope="class")
+    def corpus(self):
+        return load_corpus(COMMITTED)
+
+    def test_manifest_stats_match_recomputation(self, corpus):
+        assert family_stats(corpus.scenarios) == corpus.manifest["families"]
+
+    def test_all_families_present(self, corpus):
+        assert set(corpus.by_family) == set(FAMILIES)
+
+    def test_agent_counts_span_2_to_500(self, corpus):
+        agents = corpus.manifest["agents"]
+        assert agents["min"] == 2
+        assert agents["max"] == 500
+        assert "polarized-500" in corpus.by_id
+
+    def test_polarized_blocs_match_text(self, corpus):
+        for s in corpus.by_family["polarized"]:
+            counts = collections.Counter(s["agent_opinions"].values())
+            assert len(counts) == 2  # exactly two bloc texts
+            assert sorted(counts.values(), reverse=True) == sorted(
+                s["profile"]["bloc_sizes"], reverse=True)
+            assert sum(s["profile"]["bloc_sizes"]) == s["n_agents"]
+
+    def test_holdout_is_a_real_dissenter(self, corpus):
+        for s in corpus.by_family["holdout"]:
+            holdout = s["profile"]["holdout_agent"]
+            counts = collections.Counter(s["agent_opinions"].values())
+            if s["n_agents"] == 2:
+                assert len(counts) == 2
+                continue
+            (majority_text, majority_n), = counts.most_common(1)
+            assert majority_n == s["n_agents"] - 1
+            assert s["agent_opinions"][holdout] != majority_text
+
+    def test_sybil_multiplicity_is_verbatim_duplication(self, corpus):
+        for s in corpus.by_family["sybil"]:
+            counts = collections.Counter(s["agent_opinions"].values())
+            assert max(counts.values()) == s["profile"]["sybil_multiplicity"]
+            organic = s["n_agents"] - s["profile"]["sybil_multiplicity"]
+            assert s["profile"]["organic"] == organic >= 1
+
+    def test_paraphrase_clusters_share_long_prefixes(self, corpus):
+        for s in corpus.by_family["paraphrase"]:
+            sizes = s["profile"]["paraphrase_clusters"]
+            assert sum(sizes) == s["n_agents"]
+            # Cluster members share the whole base opinion as a prefix;
+            # group by the first 30 chars and compare the size multiset.
+            prefixes = collections.Counter(
+                text[:30] for text in s["agent_opinions"].values())
+            assert sorted(prefixes.values()) == sorted(sizes)
+
+    def test_contradictory_opinions_contain_both_stances(self, corpus):
+        for s in corpus.by_family["contradictory"]:
+            assert s["profile"]["incoherent"] == s["n_agents"]
+
+
+# ---------------------------------------------------------------------------
+# Registry refs
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_aamas_ref(self):
+        scenario = resolve_scenario_ref("aamas:1")
+        assert scenario["issue"]
+        assert len(scenario["agent_opinions"]) >= 4
+
+    def test_corpus_ref_by_id(self):
+        scenario = resolve_scenario_ref("corpus:v2:polarized-500")
+        assert scenario["family"] == "polarized"
+        assert scenario["n_agents"] == 500
+        assert len(scenario["agent_opinions"]) == 500
+
+    def test_corpus_ref_default_scenario(self):
+        scenario = resolve_scenario_ref("corpus:v2")
+        first = min(
+            load_corpus(COMMITTED).scenarios, key=lambda s: s["id"])
+        assert scenario["id"] == first["id"]
+
+    def test_corpus_ref_by_path(self, tmp_path):
+        write_corpus(tmp_path / "c", TINY_SPEC)
+        clear_corpus_cache()
+        scenario = resolve_scenario_ref(f"corpus:{tmp_path / 'c'}:mixed-0001")
+        assert scenario["family"] == "mixed"
+
+    @pytest.mark.parametrize("bad", [
+        "", "nope:1", "aamas:99", "corpus:", "corpus:v2:no-such-id",
+        "corpus:no_such_corpus_name",
+    ])
+    def test_bad_refs_raise(self, bad):
+        with pytest.raises((ValueError, KeyError, FileNotFoundError)):
+            resolve_scenario_ref(bad)
+
+    def test_corpus_root_resolves_name(self):
+        assert corpus_root("v2") == COMMITTED.resolve()
+
+    def test_maybe_resolve_passthrough_and_override(self):
+        inline = {"issue": "X?", "agent_opinions": {"A": "yes"}}
+        assert maybe_resolve_scenario(inline) == inline
+        resolved = maybe_resolve_scenario(
+            {"ref": "corpus:v2:mixed-0000", "issue": "Overridden?"})
+        assert resolved["issue"] == "Overridden?"
+        assert resolved["agent_opinions"]
+
+    def test_experiment_accepts_scenario_ref_string(self):
+        from consensus_tpu.experiment import Experiment
+
+        config = {
+            "scenario": "corpus:v2:polarized-0004",
+            "methods_to_run": [],
+            "models": {},
+        }
+        experiment = Experiment(config, backend=None)
+        assert experiment.issue
+        assert len(experiment.agent_opinions) == 13
+
+
+# ---------------------------------------------------------------------------
+# Mix parsing + deterministic sampling
+# ---------------------------------------------------------------------------
+
+
+class TestSampling:
+    @pytest.fixture(scope="class")
+    def corpus(self):
+        return load_corpus(COMMITTED)
+
+    def test_parse_family_mix(self):
+        assert parse_family_mix("polarized=2, sybil=1") == {
+            "polarized": 2.0, "sybil": 1.0}
+        with pytest.raises(ValueError):
+            parse_family_mix("polarized")
+        with pytest.raises(ValueError):
+            parse_family_mix("polarized=0")
+        with pytest.raises(ValueError):
+            parse_family_mix("")
+
+    def test_round_robin_covers_corpus_in_id_order(self, corpus):
+        n = len(corpus.scenarios)
+        seq = corpus.sample_sequence(n)
+        assert [s["id"] for s in seq] == sorted(corpus.by_id)
+
+    def test_mix_is_deterministic_and_respects_families(self, corpus):
+        seq_a = corpus.sample_sequence(
+            40, mix="polarized=3,sybil=1", base_seed=5)
+        seq_b = corpus.sample_sequence(
+            40, mix="polarized=3,sybil=1", base_seed=5)
+        assert [s["id"] for s in seq_a] == [s["id"] for s in seq_b]
+        families = collections.Counter(s["family"] for s in seq_a)
+        assert set(families) <= {"polarized", "sybil"}
+        assert families["polarized"] > families["sybil"]
+
+    def test_mix_unknown_family_raises(self, corpus):
+        with pytest.raises(ValueError):
+            corpus.sample_sequence(4, mix="nonexistent=1")
+
+
+# ---------------------------------------------------------------------------
+# Loadgen integration: corpus payloads + provenance stamping
+# ---------------------------------------------------------------------------
+
+
+class TestLoadgenCorpus:
+    def test_corpus_requests_deterministic_with_provenance(self):
+        from consensus_tpu.serve.loadgen import corpus_requests
+
+        a = corpus_requests("v2", 12, mix="polarized=1,holdout=1",
+                            base_seed=3)
+        b = corpus_requests("v2", 12, mix="polarized=1,holdout=1",
+                            base_seed=3)
+        assert a == b
+        assert a.provenance == "corpus:v2:polarized=1,holdout=1"
+        assert all(":" in p["request_id"] for p in a)
+        # Distinct seeds per request even when scenarios repeat.
+        assert len({p["seed"] for p in a}) == 12
+
+    def test_scenario_requests_provenance(self):
+        from consensus_tpu.serve.loadgen import scenario_requests
+
+        assert scenario_requests(4).provenance == "round_robin:aamas"
+        assert scenario_requests(
+            4, scenario_repeat="fixed:2").provenance == "fixed:2"
+
+    def test_report_stamps_scenario_mix(self):
+        # run_loadgen against a dead URL: every request fails, but the
+        # report must still stamp the workload provenance.
+        from consensus_tpu.serve.loadgen import (
+            corpus_requests,
+            run_loadgen,
+        )
+
+        payloads = corpus_requests("v2", 2, base_seed=1)
+        report = run_loadgen(
+            "http://127.0.0.1:9", payloads, rate_rps=100.0,
+            client_timeout_s=0.5,
+        )
+        assert report["scenario_mix"] == "corpus:v2"
+        assert report["completed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Service-level scenario refs
+# ---------------------------------------------------------------------------
+
+
+class TestServiceRefs:
+    def test_parse_request_resolves_corpus_ref(self):
+        from consensus_tpu.serve.service import parse_request
+
+        request = parse_request({
+            "scenario": "corpus:v2:holdout-0005",
+            "method": "best_of_n",
+            "params": {"n": 2},
+        })
+        assert request.issue
+        assert len(request.agent_opinions) == 21
+
+    def test_parse_request_rejects_ref_plus_inline(self):
+        from consensus_tpu.serve.service import (
+            RequestValidationError,
+            parse_request,
+        )
+
+        with pytest.raises(RequestValidationError) as excinfo:
+            parse_request({
+                "scenario": "aamas:1",
+                "issue": "inline too",
+                "method": "best_of_n",
+            })
+        assert "one or the other" in str(excinfo.value)
+
+    def test_parse_request_rejects_unknown_ref(self):
+        from consensus_tpu.serve.service import (
+            RequestValidationError,
+            parse_request,
+        )
+
+        with pytest.raises(RequestValidationError):
+            parse_request({
+                "scenario": "corpus:v2:definitely-missing",
+                "method": "best_of_n",
+            })
