@@ -480,6 +480,76 @@ def main() -> None:
             "chaos_recovery_curve": chaos_report.get("recovery_curve"),
         }
 
+    # ---- fleet-chaos cell: transport-seam faults vs a live fleet -----
+    # The PR 19 conformance surface measured: the standard seeded seam
+    # schedule (5% ship/fetch drops, 1% corruption, one 2s partition of
+    # r1) against a 3-replica elastic fleet whose PageStore traffic
+    # crosses a FaultyTransport.  Availability should hold >= 0.99 (the
+    # request path never crosses the seam; the seam degrades gracefully
+    # to cold prefill), and chaos_recovery_time_s is how long after the
+    # scheduled partition window ended the manager's probes cleared the
+    # partitioned replica.  BENCH_CHAOS=0 skips this cell too.
+    chaos_fleet_extra = {}
+    if os.environ.get("BENCH_CHAOS", "1") != "0":
+        import json as _json
+        import time as _time
+
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+
+        seam_requests = int(os.environ.get("BENCH_CHAOS_REQUESTS", "32"))
+        seam_rate = float(os.environ.get("BENCH_CHAOS_RATE", "50"))
+        seam_plan = _json.dumps({"seed": 7, "faults": [
+            {"kind": "drop", "op": "ship", "rate": 0.05},
+            {"kind": "drop", "op": "fetch", "rate": 0.05},
+            {"kind": "bit_flip", "op": "*", "rate": 0.01},
+            {"kind": "partition", "op": "*", "peer": "r1",
+             "after_s": 1.0, "duration_s": 2.0},
+        ]})
+        server = create_server(
+            backend="fake", port=0, max_inflight=4, fleet_size=3,
+            fleet_options={"elastic": True,
+                           "transport_fault_plan": seam_plan},
+        ).start()
+        try:
+            seam_report = run_loadgen(
+                server.base_url,
+                scenario_requests(seam_requests, params={
+                    "n": 8, "max_tokens": NEW_TOKENS}),
+                rate_rps=seam_rate,
+                transport_fault_plan=seam_plan,
+            )
+            # Recovery time: wait (bounded) for the manager's probes to
+            # clear the scheduled partition, then measure heal lag past
+            # the window end on the transport's own clock.
+            manager = getattr(server.scheduler, "manager", None)
+            recovery_s = None
+            if manager is not None:
+                deadline = _time.monotonic() + 15.0
+                while _time.monotonic() < deadline:
+                    if manager.snapshot().get("partition_events"):
+                        break
+                    _time.sleep(0.1)
+                events = manager.snapshot().get("partition_events") or []
+                transport = getattr(manager.page_store, "transport", None)
+                windows = (
+                    transport.partition_windows()
+                    if hasattr(transport, "partition_windows") else []
+                )
+                if events and windows:
+                    recovery_s = max(0.0, round(
+                        events[-1]["cleared_s"] - windows[0][2], 3))
+        finally:
+            server.stop()
+        chaos_fleet_extra = {
+            "chaos_fleet_availability": seam_report["availability"],
+            "chaos_fleet_p99_ms": seam_report["latency_ms"]["p99"],
+            "chaos_recovery_time_s": recovery_s,
+            "chaos_fleet_requests": seam_requests,
+            "chaos_fleet_seam_degradation": seam_report.get(
+                "seam_degradation"),
+        }
+
     # ---- brownout cell: the serve stack under deliberate overload ----
     # Open-loop load at roughly 2x the worker pool's drain rate with the
     # brownout controller ON and tight per-request deadlines: the graceful-
@@ -1452,6 +1522,7 @@ def main() -> None:
                     **mcts_extra,
                     **serve_extra,
                     **chaos_extra,
+                    **chaos_fleet_extra,
                     **brownout_extra,
                     **fleet_extra,
                     **prefix_extra,

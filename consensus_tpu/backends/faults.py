@@ -28,6 +28,12 @@ Fault kinds (``FaultSpec.kind``):
   daemon threads by serving convention; tests call ``release_hangs()`` at
   teardown to unstick them.
 
+Transport-plane kinds (``drop``, ``duplicate``, ``reorder``, ``bit_flip``,
+``partition``) share this spec/plan/seed machinery but are applied by
+``serve.transport.FaultyTransport`` against the transport ops (``ship``,
+``fetch``, ``probe``); the backend wrapper ignores them, so ONE seeded plan
+can script a whole incident across both domains.
+
 Firing is per-op and per-call-index: ``call_index`` pins a spec to the
 N-th call of that op (exact), ``after_s`` pins it to the first matching
 call at/after that much wall-clock time since backend construction (the
@@ -60,8 +66,18 @@ from consensus_tpu.backends.base import (
 )
 from consensus_tpu.obs.metrics import Registry, get_registry
 
+#: Backend protocol ops (the original injection surface).
+BACKEND_OPS = ("generate", "score", "next_token", "embed")
+
+#: Transport-plane ops (``serve/transport.py``): page-run shipping,
+#: fetching, and the health probe.  One seeded plan can address both
+#: domains — a spec with ``op="ship"`` simply never matches a backend
+#: call, and a backend-only kind firing on a transport op is ignored by
+#: the transport wrapper.
+TRANSPORT_OPS = ("ship", "fetch", "probe")
+
 #: Ops fault specs can target (``"*"`` matches all of them).
-OPS = ("generate", "score", "next_token", "embed")
+OPS = BACKEND_OPS + TRANSPORT_OPS
 
 FAULT_KINDS = (
     "transient_error",
@@ -72,7 +88,17 @@ FAULT_KINDS = (
     "latency",
     "device_lost",
     "hang",
+    # Transport-plane kinds (applied by ``serve.transport.FaultyTransport``;
+    # ignored by the backend wrapper):
+    "drop",
+    "duplicate",
+    "reorder",
+    "bit_flip",
+    "partition",
 )
+
+#: Kinds only the transport wrapper knows how to apply.
+TRANSPORT_KINDS = ("drop", "duplicate", "reorder", "bit_flip", "partition")
 
 
 def _hash_unit(*parts) -> float:
@@ -105,6 +131,12 @@ class FaultSpec:
     row_index: Optional[int] = None
     #: Added delay for ``latency`` faults.
     latency_s: float = 0.0
+    #: Window length for ``partition`` faults: the peer is unreachable for
+    #: ``[after_s, after_s + duration_s)`` on the transport's clock.
+    duration_s: float = 0.0
+    #: Peer name a ``partition`` fault isolates (None = partition the whole
+    #: seam — every peer unreachable for the window).
+    peer: Optional[str] = None
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -118,6 +150,11 @@ class FaultSpec:
             raise ValueError(f"rate must be in [0, 1], got {self.rate}")
         if self.after_s is not None and self.after_s < 0:
             raise ValueError(f"after_s must be >= 0, got {self.after_s}")
+        if self.duration_s < 0:
+            raise ValueError(
+                f"duration_s must be >= 0, got {self.duration_s}")
+        if self.kind == "partition" and self.after_s is None:
+            raise ValueError("partition faults need after_s (window start)")
 
     def matches(self, op: str) -> bool:
         return self.op == "*" or self.op == op
@@ -180,10 +217,25 @@ class FaultPlan:
 
     def firing(self, op: str, call_index: int,
                elapsed_s: float = 0.0) -> List[FaultSpec]:
-        """Specs that fire for this (op, per-op call index, elapsed time)."""
+        """Specs that fire for this (op, per-op call index, elapsed time).
+
+        ``partition`` specs are window-scheduled, not per-call — they are
+        excluded here and consumed via :meth:`partition_windows`."""
         return [
             spec for i, spec in enumerate(self.faults)
-            if spec.fires(self.seed, i, op, call_index, elapsed_s)
+            if spec.kind != "partition"
+            and spec.fires(self.seed, i, op, call_index, elapsed_s)
+        ]
+
+    def partition_windows(self) -> List[Tuple[Optional[str], float, float]]:
+        """Scheduled partitions as ``(peer, start_s, end_s)`` windows
+        relative to the consuming wrapper's construction time.  ``peer``
+        is None for a full-seam partition."""
+        return [
+            (spec.peer, float(spec.after_s),
+             float(spec.after_s) + float(spec.duration_s))
+            for spec in self.faults
+            if spec.kind == "partition" and spec.after_s is not None
         ]
 
 

@@ -18,9 +18,22 @@ from consensus_tpu.serve.autoscale import Autoscaler  # noqa: F401
 from consensus_tpu.serve.brownout import BrownoutController  # noqa: F401
 from consensus_tpu.serve.fleet import Replica, ReplicaManager  # noqa: F401
 from consensus_tpu.serve.http_frontend import ConsensusServer  # noqa: F401
-from consensus_tpu.serve.pagestore import PageStore  # noqa: F401
+from consensus_tpu.serve.pagestore import (  # noqa: F401
+    PageIntegrityError,
+    PageStore,
+    PageStoreClient,
+)
 from consensus_tpu.serve.router import FleetRouter, FleetTicket  # noqa: F401
+from consensus_tpu.serve.transport import (  # noqa: F401
+    FaultyTransport,
+    LoopbackTransport,
+    TransportDropped,
+    TransportError,
+    TransportPartitioned,
+    TransportTimeout,
+)
 from consensus_tpu.serve.scheduler import (  # noqa: F401
+    IdempotencyCache,
     RequestScheduler,
     RequestTimeout,
     SchedulerRejected,
@@ -341,6 +354,13 @@ def _create_fleet_server(
 
     built = set()  # names whose first life already consumed its fault plan
 
+    # One fleet-shared completed-result cache: schedulers record terminal
+    # results, the router consults it before failover re-dispatch — a
+    # request that completed on a dying replica is re-delivered, never
+    # re-executed (the zero-duplicates chaos invariant).
+    idempotency = IdempotencyCache(
+        max_entries=fleet_options.get("idempotency_entries", 1024))
+
     def replica_factory(name, tier=None):
         """Build one UNSTARTED replica stack.  Used for the initial fleet
         AND by the ReplicaManager for respawns/scale-ups — the one place
@@ -393,6 +413,7 @@ def _create_fleet_server(
                 "engine": engine_flag,
                 "engine_options": engine_options,
                 "telemetry": telemetry_obj,
+                "idempotency": idempotency,
             },
         )
 
@@ -407,18 +428,39 @@ def _create_fleet_server(
         tier_enter_pressure=fleet_options.get("tier_enter_pressure", 0.85),
         tier_exit_pressure=fleet_options.get("tier_exit_pressure", 0.5),
         tier_min_dwell_s=fleet_options.get("tier_min_dwell_s", 2.0),
+        idempotency_cache=idempotency,
     )
 
     autoscale = fleet_options.get("autoscale")
-    if fleet_options.get("elastic") or autoscale:
+    transport_fault_plan = fleet_options.get("transport_fault_plan")
+    if fleet_options.get("elastic") or autoscale or transport_fault_plan:
         from consensus_tpu.serve.autoscale import Autoscaler
         from consensus_tpu.serve.fleet import ReplicaManager
         from consensus_tpu.serve.pagestore import PageStore
 
         elastic_options = dict(fleet_options.get("elastic_options") or {})
+        # The PageStore ships page runs over the transport seam; a
+        # transport_fault_plan wraps the loopback hub in the seeded
+        # FaultyTransport so drops/corruption/partitions hit real traffic.
+        transport = LoopbackTransport()
+        if transport_fault_plan is not None:
+            from consensus_tpu.backends.faults import FaultPlan
+
+            transport = FaultyTransport(
+                transport,
+                FaultPlan.from_spec(transport_fault_plan),
+                registry=registry,
+            )
+        store_kwargs = {}
+        if "page_store_chunk_bytes" in elastic_options:
+            store_kwargs["chunk_bytes"] = elastic_options.pop(
+                "page_store_chunk_bytes")
         store = PageStore(
             max_runs=elastic_options.pop("page_store_runs", 256),
             registry=registry,
+            transport=transport,
+            lease_s=elastic_options.pop("page_store_lease_s", None),
+            **store_kwargs,
         )
         manager = ReplicaManager(
             router,

@@ -173,6 +173,12 @@ class Replica:
         self.scheduler.replica_tier = tier
         self._lost = threading.Event()
         self._lost_reason = ""
+        #: Transport-seam health (set by the manager's transport probes):
+        #: a partitioned replica is DEGRADED — routed around while healthy
+        #: peers exist, but still a last resort (a partitioned seam stops
+        #: warm handoff, not serving) — and auto-heals when probes pass.
+        self.transport_ok = True
+        self.transport_reason = ""
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -228,6 +234,8 @@ class Replica:
             return DRAINING
         breaker = self.scheduler.circuit_breaker
         if breaker is not None and breaker.state == "open":
+            return DEGRADED
+        if not self.transport_ok:
             return DEGRADED
         return HEALTHY
 
@@ -288,6 +296,10 @@ class Replica:
         }
         if self.lost_reason:
             snap["lost_reason"] = self.lost_reason
+        if not self.transport_ok:
+            snap["transport"] = {
+                "ok": False, "reason": self.transport_reason,
+            }
         for key in ("engine", "circuit_breaker", "brownout"):
             if key in stats:
                 snap[key] = stats[key]
@@ -357,6 +369,7 @@ class ReplicaManager:
         check_interval_s: float = 0.2,
         harvest_interval_s: float = 0.5,
         retire_timeout_s: float = 2.0,
+        transport_probe_failures: int = 2,
         auto_start: bool = True,
         clock=time.monotonic,
     ):
@@ -381,6 +394,14 @@ class ReplicaManager:
         self._pending: Dict[str, Any] = {}
         self._quarantined: Dict[str, str] = {}
         self._last_harvest = 0.0
+        #: Transport-probe ladder: consecutive failures per replica name;
+        #: at ``transport_probe_failures`` the replica is marked
+        #: transport-partitioned (DEGRADED, auto-healing) — the seam
+        #: analogue of the flap quarantine, except probes clear it.
+        self.transport_probe_failures = max(1, int(transport_probe_failures))
+        self._transport_fails: Dict[str, int] = {}
+        self._partitioned: Dict[str, float] = {}
+        self._partition_events: List[Dict[str, float]] = []
         self._next_index = 1 + max(
             (_name_index(r.name) for r in router.replicas), default=-1
         )
@@ -455,10 +476,65 @@ class ReplicaManager:
     def tick(self) -> None:
         """One monitor pass (public so tests can step deterministically)."""
         now = self._clock()
+        self._probe_transport(now)
         self._harvest(now)
         self._detect_losses(now)
         self._process_pending(now)
         self._reconcile(now)
+
+    def _store_client(self, name: str):
+        """The store's named transport client for one replica (falls back
+        to the store itself for stores without the transport seam)."""
+        client_of = getattr(self.page_store, "client", None)
+        return client_of(name) if callable(client_of) else self.page_store
+
+    def _probe_transport(self, now: float) -> None:
+        """Per-replica transport health: each live replica probes the
+        store through its OWN named client, so a partition isolating one
+        peer fails exactly that peer's probes.  ``transport_probe_failures``
+        consecutive failures mark the replica DEGRADED (routed around,
+        not lost); the first passing probe heals it and records the
+        partition event with its detect/clear timestamps — recovery time
+        after a partition heals is ``cleared_s`` minus the window end."""
+        if self.page_store is None:
+            return
+        if not callable(getattr(self.page_store, "client", None)):
+            return
+        for replica in self.router.replicas:
+            if replica.lost:
+                continue
+            try:
+                ok = self._store_client(replica.name).probe(attempts=1)
+            except Exception:
+                ok = False
+            name = replica.name
+            if ok:
+                self._transport_fails[name] = 0
+                detected = self._partitioned.pop(name, None)
+                if detected is not None:
+                    replica.transport_ok = True
+                    replica.transport_reason = ""
+                    with self._lock:
+                        self._partition_events.append({
+                            "replica": name,
+                            "detected_s": detected,
+                            "cleared_s": now,
+                        })
+                        del self._partition_events[:-32]
+                    get_flight_recorder().record_event(
+                        "transport_heal", replica=name,
+                        partitioned_s=now - detected)
+                continue
+            fails = self._transport_fails.get(name, 0) + 1
+            self._transport_fails[name] = fails
+            if fails >= self.transport_probe_failures and (
+                    name not in self._partitioned):
+                self._partitioned[name] = now
+                replica.transport_ok = False
+                replica.transport_reason = (
+                    f"transport probe failed x{fails}")
+                get_flight_recorder().record_event(
+                    "transport_partition", replica=name, failures=fails)
 
     def _harvest(self, now: float) -> None:
         if self.page_store is None:
@@ -472,7 +548,7 @@ class ReplicaManager:
             engine = replica.scheduler.batching.engine
             if engine is not None:
                 try:
-                    self.page_store.capture_engine(engine)
+                    self._store_client(replica.name).capture_engine(engine)
                 except Exception:
                     # A replica dying mid-harvest is the loss path's
                     # problem, not the harvester's.
@@ -575,7 +651,7 @@ class ReplicaManager:
             engine = replica.scheduler.batching.engine
             if engine is not None:
                 try:
-                    self.page_store.seed_engine(engine)
+                    self._store_client(name).seed_engine(engine)
                 except Exception:
                     pass  # cold join is a degraded start, not a failure
         self.router.add_replica(replica)
@@ -610,6 +686,9 @@ class ReplicaManager:
                 "losses": self.losses,
                 "pending_respawns": sorted(self._pending),
                 "quarantined": dict(self._quarantined),
+                "partitioned": dict(self._partitioned),
+                "partition_events": [
+                    dict(e) for e in self._partition_events],
                 "flap_threshold": self.flap_threshold,
                 "flap_window_s": self.flap_window_s,
                 "page_store": (

@@ -247,6 +247,7 @@ def run_loadgen(
     curve_bucket_s: Optional[float] = None,
     include_slo: bool = False,
     scenario_mix: Optional[str] = None,
+    transport_fault_plan: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Replay ``payloads`` open-loop at ``rate_rps`` against ``base_url``.
 
@@ -326,6 +327,10 @@ def run_loadgen(
     spec_before = fetch_speculative_stats(base_url)
     threads: List[threading.Thread] = []
     start_wall = time.perf_counter()
+    # Seam-degradation windows are recorded on time.monotonic (the
+    # PageStore clients' clock); anchor it so they can be re-based onto
+    # the run timeline next to the recovery curve's buckets.
+    start_mono = time.monotonic()
     for i, payload in enumerate(payloads):
         # Open loop: hold the schedule even if earlier requests are slow.
         target = start_wall + i / rate_rps
@@ -477,6 +482,42 @@ def run_loadgen(
             report["fleet"]["quarantined"] = list(
                 manager_after.get("quarantined") or []
             )
+            # Seam-degradation windows: when the PageStore transport seam
+            # degraded (client retry exhaustion) and when the manager's
+            # probes detected/cleared replica partitions — re-based from
+            # time.monotonic onto the run timeline so they line up with
+            # the recovery curve's buckets above.
+            def _rel(stamp: Any) -> Optional[float]:
+                if stamp is None:
+                    return None
+                return round(float(stamp) - start_mono, 3)
+
+            store_stats = manager_after.get("page_store")
+            seam: Dict[str, Any] = {}
+            if isinstance(store_stats, dict):
+                windows = store_stats.get("degradation_windows") or []
+                seam["degraded_clients"] = list(
+                    store_stats.get("degraded_clients") or []
+                )
+                seam["degradation_windows"] = [
+                    {
+                        "client": w.get("client"),
+                        "enter_s": _rel(w.get("enter_s")),
+                        "exit_s": _rel(w.get("exit_s")),
+                    }
+                    for w in windows
+                ]
+            partition_events = manager_after.get("partition_events") or []
+            seam["partition_events"] = [
+                {
+                    "replica": e.get("replica"),
+                    "detected_s": _rel(e.get("detected_s")),
+                    "cleared_s": _rel(e.get("cleared_s")),
+                }
+                for e in partition_events
+            ]
+            if seam.get("degradation_windows") or seam["partition_events"]:
+                report["seam_degradation"] = seam
         report["replica_request_counts"] = replica_counts
         report["failover_fraction"] = (
             round(failovers / len(ok), 4) if ok else 0.0
@@ -499,6 +540,12 @@ def run_loadgen(
         scenario_mix
         if scenario_mix is not None
         else getattr(payloads, "provenance", "unspecified")
+    )
+    # Transport-fault-plan provenance: a recovery curve measured under a
+    # seeded seam fault schedule and one measured fault-free are different
+    # claims — the header says which schedule (if any) was in force.
+    report["transport_fault_plan"] = (
+        transport_fault_plan if transport_fault_plan else "none"
     )
     prefix_after = fetch_prefix_stats(base_url)
     if prefix_after is not None:

@@ -59,9 +59,11 @@ from consensus_tpu.obs.metrics import Registry, get_registry
 from consensus_tpu.obs.trace import trace_current, use_trace
 from consensus_tpu.serve.fleet import DEGRADED, HEALTHY, Replica
 from consensus_tpu.serve.scheduler import (
+    IdempotencyCache,
     RequestTimeout,
     SchedulerRejected,
     Ticket,
+    idempotency_key,
 )
 
 #: Waiter-loop granularity: how often a parked waiter re-checks the serving
@@ -267,6 +269,7 @@ class FleetRouter:
         tier_enter_pressure: float = 0.85,
         tier_exit_pressure: float = 0.5,
         tier_min_dwell_s: float = 2.0,
+        idempotency_cache: Optional[IdempotencyCache] = None,
     ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -326,6 +329,16 @@ class FleetRouter:
         self._m_hedges = reg.counter(
             "fleet_hedges_total",
             "Hedge dispatches issued for tail-latency-critical tickets.")
+        #: Shared completed-result cache (set by fleet wiring): a failover
+        #: whose request already finished on the dying replica resolves
+        #: from here instead of executing twice — the zero-duplicates
+        #: invariant the chaos conformance suite pins.
+        self.idempotency_cache = idempotency_cache
+        self._m_idempotent = reg.counter(
+            "fleet_idempotent_hits_total",
+            "Failover re-dispatches resolved from the fleet idempotency "
+            "cache (the first replica completed the request before dying; "
+            "the cached result is re-delivered, not recomputed).")
         #: Scenario affinity effectiveness: a hit means the request landed
         #: on its rendezvous-first replica — the one holding the scenario's
         #: warm prefix-cache entries.  Misses (spillover under backpressure,
@@ -704,6 +717,24 @@ class FleetRouter:
             ticket._resolve("timeout", error=RequestTimeout(
                 "deadline expired while failing over"))
             return True
+        # Exactly-once delivery: if the request already completed on the
+        # replica that just died (computed but not yet delivered), resolve
+        # from the fleet idempotency cache instead of executing it again.
+        if self.idempotency_cache is not None:
+            record = self.idempotency_cache.get(idempotency_key(
+                ticket.request,
+                getattr(ticket.request, "method", "unknown"),
+            ))
+            if record is not None:
+                self._m_idempotent.inc()
+                value = record["value"]
+                if isinstance(value, dict):
+                    value = dict(value)
+                    value["served_by"] = record.get("replica", "")
+                    value["served_tier"] = record.get("tier", "")
+                    value["idempotent_replay"] = True
+                ticket._resolve(record["outcome"], value=value)
+                return True
         tier = self._serving_tier()
         key = _scenario_key(ticket.request)
         with ticket._lock:
@@ -890,6 +921,8 @@ class FleetRouter:
             "routed": routed,
             "replicas": replicas,
         }
+        if self.idempotency_cache is not None:
+            stats["fleet"]["idempotency"] = self.idempotency_cache.stats()
         if self.manager is not None:
             stats["fleet"]["manager"] = self.manager.snapshot()
         if self.autoscaler is not None:

@@ -38,6 +38,7 @@ Obs families (land in ``metrics.json`` / ``metrics.prom`` / ``/metrics``):
 from __future__ import annotations
 
 import collections
+import hashlib
 import inspect
 import logging
 import threading
@@ -79,6 +80,77 @@ class SchedulerRejected(Exception):
 
 class RequestTimeout(Exception):
     """The request's deadline expired before a result was produced."""
+
+
+def idempotency_key(request: Any, method: str = "") -> Optional[str]:
+    """Stable identity for one request's result, or None when the request
+    carries no ``request_id`` (anonymous requests are never deduplicated).
+    The id alone is not enough — a reused id with different content must
+    NOT collide — so the key hashes id + method + the semantic fields."""
+    request_id = getattr(request, "request_id", None)
+    if not request_id:
+        return None
+    h = hashlib.blake2b(digest_size=16)
+    for part in (
+        request_id, method,
+        getattr(request, "seed", ""), getattr(request, "issue", ""),
+        getattr(request, "n", ""), getattr(request, "max_tokens", ""),
+    ):
+        h.update(str(part).encode("utf-8", "replace"))
+        h.update(b"\x1f")
+    return h.hexdigest()
+
+
+class IdempotencyCache:
+    """Bounded LRU of completed results keyed by request identity.
+
+    Shared across a fleet: every replica's scheduler records terminal
+    ok/degraded results; the router consults it before RE-dispatching a
+    failed-over ticket, so a request whose first replica died AFTER
+    computing the answer is resolved from the cache instead of executed a
+    second time — zero duplicated requests under chaos, byte-identical
+    re-delivery."""
+
+    def __init__(self, max_entries: int = 1024):
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, Dict[str, Any]]" = (
+            collections.OrderedDict()
+        )
+        self.hits = 0
+        self.puts = 0
+
+    def put(self, key: str, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self.puts += 1
+            self._entries[key] = record
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def get(self, key: Optional[str]) -> Optional[Dict[str, Any]]:
+        if key is None:
+            return None
+        with self._lock:
+            record = self._entries.get(key)
+            if record is None:
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return record
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "max_entries": self.max_entries,
+                "hits": self.hits,
+                "puts": self.puts,
+            }
 
 
 class Ticket:
@@ -168,6 +240,7 @@ class RequestScheduler:
         engine: bool = True,
         engine_options: Optional[Dict[str, Any]] = None,
         telemetry: Optional[Any] = None,
+        idempotency: Optional["IdempotencyCache"] = None,
     ):
         if max_queue_depth < 1 or max_inflight < 1:
             raise ValueError("max_queue_depth and max_inflight must be >= 1")
@@ -259,6 +332,12 @@ class RequestScheduler:
         #: None (the default) keeps the hot path byte-identical: the only
         #: cost is one attribute check per terminal request.
         self.telemetry = telemetry
+        #: Optional fleet-shared :class:`IdempotencyCache`: completed
+        #: results are recorded by request identity so a router re-dispatch
+        #: of an already-answered request (its first replica died between
+        #: computing and delivering) returns the SAME bytes instead of
+        #: executing twice.
+        self.idempotency = idempotency
 
         self._lock = threading.Lock()
         self._work_cv = threading.Condition(self._lock)
@@ -621,4 +700,14 @@ class RequestScheduler:
                     else None
                 ),
             )
+        if (self.idempotency is not None and value is not None
+                and outcome in ("ok", "degraded")):
+            key = idempotency_key(ticket.request, method)
+            if key is not None:
+                self.idempotency.put(key, {
+                    "outcome": outcome,
+                    "value": value,
+                    "replica": self.replica_name,
+                    "tier": self.replica_tier,
+                })
         ticket._finish(outcome, value=value, error=error)

@@ -49,6 +49,13 @@ DEFAULT_GATES: List[Tuple[str, str, float]] = [
     ("extra.serve_throughput_rps", "higher", 0.5),
     ("extra.serve_p99_ms", "lower", 1.5),
     ("extra.chaos_success_frac", "higher", 0.15),
+    # Transport-seam chaos conformance (PR 19): availability under the
+    # standard seeded seam schedule should hold near 1.0 (the request
+    # path never crosses the seam); recovery time and tail latency are
+    # probe-cadence-scale numbers with wide CPU-smoke bounds.
+    ("extra.chaos_fleet_availability", "higher", 0.15),
+    ("extra.chaos_fleet_p99_ms", "lower", 1.5),
+    ("extra.chaos_recovery_time_s", "lower", 1.5),
     ("extra.brownout_availability", "higher", 0.15),
     ("extra.fleet_availability", "higher", 0.15),
     ("extra.padding_efficiency", "higher", 0.3),

@@ -242,6 +242,20 @@ def main(argv=None) -> int:
                              '"transient_error", "rate": 0.05}]}\'; the '
                              "report gains availability and retried "
                              "fraction")
+    parser.add_argument("--transport-fault-plan", default=None,
+                        help="(self-contained, fleet) JSON fault plan "
+                             "injected into the PageStore transport seam "
+                             "(ops ship/fetch/probe; kinds drop, "
+                             "duplicate, reorder, bit_flip, partition, "
+                             "latency, ...), e.g. '{\"seed\": 7, "
+                             '"faults": [{"kind": "drop", "op": "ship", '
+                             '"rate": 0.05}, {"kind": "partition", '
+                             '"op": "*", "peer": "r1", "after_s": 1.0, '
+                             "\"duration_s\": 2.0}]}'; implies elastic "
+                             "fleet plumbing and stamps the plan as "
+                             "transport_fault_plan provenance in the "
+                             "report, next to the seam_degradation "
+                             "windows")
     args = parser.parse_args(argv)
     if bool(args.url) == bool(args.self_contained):
         parser.error("exactly one of --url / --self-contained is required")
@@ -301,6 +315,9 @@ def main(argv=None) -> int:
         if args.watchdog_timeout_s is not None:
             fleet_options.setdefault(
                 "watchdog_timeout_s", args.watchdog_timeout_s)
+        if args.transport_fault_plan is not None:
+            fleet_options.setdefault(
+                "transport_fault_plan", args.transport_fault_plan)
         server = create_server(
             backend="fake",
             port=0,  # ephemeral
@@ -348,6 +365,7 @@ def main(argv=None) -> int:
                 server.base_url, payloads, args.rate,
                 client_timeout_s=args.client_timeout_s,
                 include_slo=args.slo,
+                transport_fault_plan=args.transport_fault_plan,
             )
             report["device_batches"] = server.scheduler.stats()[
                 "device_batches"]
@@ -395,6 +413,7 @@ def main(argv=None) -> int:
             args.url, payloads, args.rate,
             client_timeout_s=args.client_timeout_s,
             include_slo=args.slo,
+            transport_fault_plan=args.transport_fault_plan,
         )
 
     print(report_json(report))
