@@ -1200,6 +1200,93 @@ def main() -> None:
                     "handoff); replica count monotone per phase",
         }}
 
+    # ---- BENCH_RESTART: zero-loss rolling restart of a durable fleet -----
+    # The PR 20 acceptance surface measured: a 3-replica elastic fleet with
+    # a state_dir (durable idempotency snapshot + disk-backed PageStore
+    # spill) takes a full rolling restart — drain -> capture -> respawn ->
+    # warm-seed -> health-gated rejoin, one replica at a time — while
+    # open-loop load keeps arriving.  Reported: availability through the
+    # cycle (goal >= 0.99), the fraction of respawns that warm-seeded at
+    # least one run from the durable PageStore (goal: all of them), and
+    # the slowest per-replica drain->rejoin time.  BENCH_RESTART=0 skips.
+    restart_extra = {}
+    if os.environ.get("BENCH_RESTART", "1") != "0":
+        import tempfile as _tempfile
+        import threading as _rthreading
+
+        from consensus_tpu.serve import create_server
+        from consensus_tpu.serve.loadgen import run_loadgen, scenario_requests
+
+        restart_requests = int(os.environ.get("BENCH_RESTART_REQUESTS", "36"))
+        restart_rate = float(os.environ.get("BENCH_RESTART_RATE", "60"))
+        restart_payloads = scenario_requests(
+            restart_requests, params={"n": 4, "max_tokens": NEW_TOKENS},
+            timeout_s=30.0, scenario_repeat="fixed:2",
+        )
+        restart_state_dir = _tempfile.mkdtemp(prefix="bench-restart-")
+        server = create_server(
+            backend="fake", port=0, max_inflight=2, max_queue_depth=16,
+            default_timeout_s=30.0, state_dir=restart_state_dir,
+            engine_options={"prefix_cache": True},
+            fleet_size=3,
+            fleet_options={
+                "elastic": True,
+                "elastic_options": {"check_interval_s": 0.05,
+                                    "respawn_backoff_s": 0.05,
+                                    "harvest_interval_s": 0.1},
+            },
+        ).start()
+        restart_manager = server.scheduler.manager
+        restart_outcome = {}
+        try:
+            # Prime the PageStore (harvested prefix runs are what respawns
+            # warm-seed from), then restart the fleet under fresh load.
+            run_loadgen(server.base_url, restart_payloads,
+                        rate_rps=restart_rate)
+            prime_deadline = time.perf_counter() + 10.0
+            while (time.perf_counter() < prime_deadline
+                   and not len(restart_manager.page_store)):
+                time.sleep(0.05)
+            restarter = _rthreading.Timer(
+                0.2,
+                lambda: restart_outcome.update(
+                    restart_manager.rolling_restart()),
+            )
+            restarter.daemon = True
+            restarter.start()
+            restart_report = run_loadgen(
+                server.base_url, restart_payloads, rate_rps=restart_rate)
+            restarter.join(timeout=60.0)
+            restart_snap = restart_manager.snapshot()
+        finally:
+            server.stop(drain=False)
+        restart_events = restart_snap.get("restart_events") or []
+        restart_recover_times = [
+            round(e["completed_s"] - e["started_s"], 3)
+            for e in restart_events
+            if e.get("completed_s") is not None
+            and e.get("started_s") is not None
+        ]
+        restart_extra = {
+            "restart_availability": restart_report["availability"],
+            "restart_warm_seed_fraction": round(
+                sum(1 for e in restart_events
+                    if (e.get("warm_seeded") or 0) > 0)
+                / len(restart_events), 4) if restart_events else None,
+            "restart_recovery_time_s": (
+                max(restart_recover_times)
+                if restart_recover_times else None),
+            "restart_recovery_times_s": restart_recover_times,
+            "restart_replicas_cycled": restart_snap.get("restarts", 0),
+            "restart_aborted": restart_outcome.get("aborted"),
+            "restart_requests": restart_requests,
+            "restart_offered_rate_rps": restart_rate,
+            "restart_goal": "availability >= 0.99 while every replica is "
+                            "drained, restarted, warm-seeded from the "
+                            "durable PageStore, and health-gated back in, "
+                            "one at a time",
+        }
+
     # ---- BENCH_OBS: welfare telemetry plane cost + federation proof ------
     # Two claims measured: (1) the telemetry plane (latency + welfare
     # quantile sketches, drift detector, SLO engine) costs < 2% serve
@@ -1530,6 +1617,7 @@ def main() -> None:
                     **mesh_extra,
                     **score_extra,
                     **elastic_extra,
+                    **restart_extra,
                     **obs_extra,
                     **spec_extra,
                     "weights": "random",

@@ -41,6 +41,7 @@ import yaml
 
 from consensus_tpu.backends.base import Backend, GenerationRequest, ScoreRequest
 from consensus_tpu.utils.identifiers import create_method_identifier
+from consensus_tpu.utils.io_atomic import sanitize_frame_for_csv
 
 logger = logging.getLogger(__name__)
 
@@ -539,7 +540,8 @@ class StatementEvaluator:
             )
             seed_dir = base / model_dir / f"seed_{seed_index}"
             seed_dir.mkdir(parents=True, exist_ok=True)
-            frame.to_csv(seed_dir / "evaluation_results.csv", index=False)
+            sanitize_frame_for_csv(frame).to_csv(
+                seed_dir / "evaluation_results.csv", index=False)
             with open(seed_dir / "evaluation_config.yaml", "w") as fh:
                 yaml.safe_dump(
                     {

@@ -57,6 +57,7 @@ from consensus_tpu.utils.io_atomic import (
     atomic_write_json,
     atomic_write_text,
     read_journal,
+    sanitize_frame_for_csv,
 )
 from consensus_tpu.utils.tracing import device_trace, get_tracer
 
@@ -437,7 +438,8 @@ class Experiment:
         rest = sorted(c for c in frame.columns if c not in lead)
         frame = frame[lead + rest]
         atomic_write_text(
-            self.run_dir / "results.csv", frame.to_csv(index=False)
+            self.run_dir / "results.csv",
+            sanitize_frame_for_csv(frame).to_csv(index=False),
         )
         get_tracer().write(self.run_dir / "timing.json")
         self._write_metrics(metrics_before, spans_before)

@@ -26,6 +26,18 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax >= 0.6 exposes shard_map at top level with the replication check
+# renamed check_vma; older jax carries it in jax.experimental with
+# check_rep.  Same semantics either way (the check stays off: the ring
+# accumulator is deliberately unreplicated).
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _CHECK_KWARGS = {"check_vma": False}
+else:  # pragma: no cover - exercised on older jax only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KWARGS = {"check_rep": False}
+
 SEQ_AXIS = "sequence"
 
 _NEG_INF = -1e30
@@ -58,10 +70,13 @@ def _attend_block(
 
 def _ring_attention_local(
     q, k, v, q_pos, kv_pos, q_valid, kv_valid, *, axis_name: str, scale: float,
-    causal: bool,
+    causal: bool, n_shards: int,
 ):
-    """Per-shard body: rotate K/V around the ring, stream the softmax."""
-    n_shards = jax.lax.axis_size(axis_name)
+    """Per-shard body: rotate K/V around the ring, stream the softmax.
+
+    ``n_shards`` is threaded in statically from the mesh (it sizes the
+    ppermute ring and the scan length; ``jax.lax.axis_size`` only exists
+    on newer jax, and the mesh knows the answer anyway)."""
     batch, s_q, heads, _ = q.shape
 
     run_max = jnp.full((batch, heads, s_q), _NEG_INF, jnp.float32)
@@ -123,14 +138,15 @@ def ring_self_attention(
     spec_2d = P(None, axis_name)
 
     body = functools.partial(
-        _ring_attention_local, axis_name=axis_name, scale=scale, causal=causal
+        _ring_attention_local, axis_name=axis_name, scale=scale,
+        causal=causal, n_shards=int(mesh.shape[axis_name]),
     )
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         body,
         mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_2d, spec_2d, spec_2d, spec_2d),
         out_specs=spec_qkv,
-        check_vma=False,
+        **_CHECK_KWARGS,
     )
     return sharded(q, k, v, positions, positions, valid, valid)
 

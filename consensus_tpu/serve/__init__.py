@@ -73,8 +73,21 @@ def create_server(
     telemetry_options=None,
     slo=False,
     slo_options=None,
+    state_dir=None,
 ) -> ConsensusServer:
     """Wire backend → service → scheduler → HTTP server (not yet started).
+
+    ``state_dir`` (``--state-dir`` on the CLI) arms the durable-state
+    layer, all files under one directory so crash recovery is "relaunch
+    with the same flag": the fsync'd request WAL
+    (:mod:`consensus_tpu.serve.wal`; single-scheduler path), durable
+    idempotency-cache snapshots (``idempotency.json``), and — for elastic
+    fleets — a disk-backed PageStore spill tier (``pages/``).  A
+    relaunched server replays unresolved journal entries through normal
+    admission and serves already-answered ones from the snapshot as
+    ``idempotent_replay``.  Unset (the default), the serving path is
+    byte-identical to the non-durable build (pinned in
+    tests/test_durability.py).
 
     ``fault_plan`` (chaos testing) and ``supervise`` layer the
     fault-tolerance stack over the engine via
@@ -188,6 +201,7 @@ def create_server(
             telemetry_obj=telemetry_obj,
             slo=slo,
             slo_options=slo_options,
+            state_dir=state_dir,
         )
 
     inner = get_backend(backend, **(backend_options or {}))
@@ -205,6 +219,21 @@ def create_server(
             registry=registry,
         )
     service = ConsensusService(inner, generation_model=generation_model)
+    wal = None
+    idempotency = None
+    if state_dir is not None:
+        import pathlib
+
+        from consensus_tpu.serve.wal import RequestWAL
+
+        state_path = pathlib.Path(state_dir)
+        # snapshot_every=1: the WAL already fsyncs per record, so the
+        # snapshot matching that cadence is what makes "crash after
+        # resolve" deterministically replay from cache (not recompute).
+        idempotency = IdempotencyCache(
+            snapshot_path=state_path / "idempotency.json",
+            snapshot_every=1)
+        wal = RequestWAL(state_path, registry=registry)
     scheduler = RequestScheduler(
         handler=service.run,
         backend=inner,
@@ -219,6 +248,8 @@ def create_server(
         engine=engine,
         engine_options=engine_options,
         telemetry=telemetry_obj,
+        idempotency=idempotency,
+        wal=wal,
     )
     slo_engine = _build_slo_engine(
         slo, slo_options, registry, scheduler.stats, telemetry_obj
@@ -312,6 +343,7 @@ def _create_fleet_server(
     telemetry_obj=None,
     slo=False,
     slo_options=None,
+    state_dir=None,
 ):
     """Build N replica stacks behind a :class:`FleetRouter`.
 
@@ -357,9 +389,24 @@ def _create_fleet_server(
     # One fleet-shared completed-result cache: schedulers record terminal
     # results, the router consults it before failover re-dispatch — a
     # request that completed on a dying replica is re-delivered, never
-    # re-executed (the zero-duplicates chaos invariant).
+    # re-executed (the zero-duplicates chaos invariant).  With a state
+    # dir, the cache is durable: snapshots survive a full-fleet restart,
+    # and the disk-backed PageStore (below) survives warm KV with it —
+    # the fleet's durability story; the per-request WAL stays single-path
+    # (one journal cannot have N replica writers).
+    state_path = None
+    if state_dir is not None:
+        import pathlib
+
+        state_path = pathlib.Path(state_dir)
+        state_path.mkdir(parents=True, exist_ok=True)
     idempotency = IdempotencyCache(
-        max_entries=fleet_options.get("idempotency_entries", 1024))
+        max_entries=fleet_options.get("idempotency_entries", 1024),
+        snapshot_path=(
+            state_path / "idempotency.json"
+            if state_path is not None else None
+        ),
+    )
 
     def replica_factory(name, tier=None):
         """Build one UNSTARTED replica stack.  Used for the initial fleet
@@ -455,6 +502,11 @@ def _create_fleet_server(
         if "page_store_chunk_bytes" in elastic_options:
             store_kwargs["chunk_bytes"] = elastic_options.pop(
                 "page_store_chunk_bytes")
+        disk_budget = elastic_options.pop(
+            "page_store_disk_budget_bytes", None)
+        if state_path is not None:
+            store_kwargs["spill_dir"] = state_path / "pages"
+            store_kwargs["disk_budget_bytes"] = disk_budget
         store = PageStore(
             max_runs=elastic_options.pop("page_store_runs", 256),
             registry=registry,

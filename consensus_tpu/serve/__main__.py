@@ -118,6 +118,14 @@ def main(argv=None) -> int:
                              "shard TPU backend params Megatron-style over "
                              "tp and partition the decode engine's slots + "
                              "page pools over dp (e.g. --mesh dp=4,tp=2)")
+    parser.add_argument("--state-dir", default=None, metavar="DIR",
+                        help="arm the durable-state layer: fsync'd request "
+                             "WAL + idempotency snapshots (single server) "
+                             "and the disk-backed PageStore spill tier "
+                             "(elastic fleets), all under DIR; relaunching "
+                             "with the same DIR after a crash replays "
+                             "unresolved requests and warm-seeds KV from "
+                             "disk")
     parser.add_argument("--blackbox", default=None, metavar="PATH",
                         help="write the flight recorder's blackbox JSON "
                              "(recent iterations + fleet events) to PATH on "
@@ -184,13 +192,15 @@ def main(argv=None) -> int:
         mesh=args.mesh,
         telemetry=args.telemetry,
         slo=(json.loads(args.slo_specs) if args.slo_specs else args.slo),
+        state_dir=args.state_dir,
     )
     stop = threading.Event()
+    shutdown_reason = ["exit"]
 
     def handle_signal(signum, frame):
         logging.getLogger("consensus_tpu.serve").info(
             "signal %d: draining and shutting down", signum)
-        get_flight_recorder().dump(
+        shutdown_reason[0] = (
             "sigterm" if signum == signal.SIGTERM else "sigint")
         stop.set()
 
@@ -217,8 +227,24 @@ def main(argv=None) -> int:
     try:
         stop.wait()
     finally:
-        server.stop(drain=True)
+        _shutdown(server, shutdown_reason[0])
     return 0
+
+
+def _shutdown(server, reason: str) -> None:
+    """Deterministic shutdown ordering: drain → WAL seal → blackbox dump.
+
+    The signal handler only records the reason and sets the stop event;
+    the actual teardown happens here, on the main thread.  ``stop()``
+    drains the scheduler, which seals the WAL as its last act — so by the
+    time the flight recorder dumps, the journal is sealed and the
+    blackbox can never capture a half-sealed journal (pinned in
+    tests/test_durability.py)."""
+    from consensus_tpu.obs.trace import get_flight_recorder
+
+    server.stop(drain=True)
+    if reason != "exit":
+        get_flight_recorder().dump(reason)
 
 
 if __name__ == "__main__":

@@ -405,6 +405,17 @@ class ReplicaManager:
         self._next_index = 1 + max(
             (_name_index(r.name) for r in router.replicas), default=-1
         )
+        #: Rolling-restart state: while True, reconciliation is paused so
+        #: the one-at-a-time drain window is not "fixed" by a scale-up.
+        self._restarting = False
+        self.restarts = 0
+        #: Completed per-replica restart events (monotonic stamps, for the
+        #: loadgen timeline): {replica, started_s, completed_s,
+        #: warm_seeded}.
+        self._restart_events: List[Dict[str, Any]] = []
+        #: name -> runs adopted by the last _spawn's warm seed (the
+        #: warm-seed-fraction evidence for respawned/restarted replicas).
+        self._warm_seeded: Dict[str, int] = {}
 
         reg = registry if registry is not None else get_registry()
         self._m_respawns = reg.counter(
@@ -423,6 +434,11 @@ class ReplicaManager:
             "toward (autoscaler-driven when one is attached).",
         )
         self._m_target.set(self.target)
+        self._m_rolling = reg.counter(
+            "fleet_rolling_restarts_total",
+            "Replicas cycled by rolling_restart() (drain -> capture -> "
+            "respawn -> warm-seed -> health-gated rejoin).",
+        )
 
         router.manager = self
         self._stop = threading.Event()
@@ -612,6 +628,11 @@ class ReplicaManager:
                     self._pending[name] = (now + backoff, tier)
 
     def _reconcile(self, now: float) -> None:
+        if self._restarting:
+            # A rolling restart deliberately runs one member below target
+            # during each drain window; backfilling that hole would spawn
+            # an extra replica the restart never asked for.
+            return
         with self._lock:
             effective_target = max(1, self.target - len(self._quarantined))
             pending = len(self._pending)
@@ -641,6 +662,98 @@ class ReplicaManager:
                         "scale_down", replica=removed.name)
                     self._retire_async(removed, drain=True)
 
+    # -- rolling restart ----------------------------------------------------
+
+    def rolling_restart(
+        self,
+        drain_timeout_s: float = 10.0,
+        health_timeout_s: float = 10.0,
+        poll_interval_s: float = 0.02,
+    ) -> Dict[str, Any]:
+        """Cycle every live replica through a zero-loss restart, ONE at a
+        time: drain → capture prefix KV to the store (and, when the store
+        is disk-backed, to disk) → respawn a fresh stack under the same
+        name → warm-seed it from the store → health-gated rejoin.  The
+        next member starts only after the previous one rejoined HEALTHY;
+        a member that fails its health gate ABORTS the remainder (the
+        fleet is left with N-0 members serving — the unhealthy respawn
+        stays registered so the loss ladder/respawn path deals with it).
+
+        Removing the member from the router BEFORE its drain means new
+        traffic fails over immediately; in-flight work completes inside
+        the drain.  Reconciliation is paused for the duration so the
+        deliberate one-member hole is not backfilled, and the member's
+        flap history is cleared — a deliberate restart is not a flap.
+
+        Returns ``{restarted, aborted, events}`` (monotonic stamps, ready
+        for the loadgen timeline)."""
+        plan = [
+            (r.name, r.tier) for r in self.router.replicas if not r.lost
+        ]
+        result: Dict[str, Any] = {
+            "restarted": [], "aborted": None, "events": [],
+        }
+        self._restarting = True
+        get_flight_recorder().record_event(
+            "rolling_restart_begin", replicas=len(plan))
+        try:
+            for name, tier in plan:
+                replica = next(
+                    (r for r in self.router.replicas if r.name == name),
+                    None,
+                )
+                if replica is None or replica.lost:
+                    continue  # lost since planning: the respawn path owns it
+                started = self._clock()
+                if self.page_store is not None:
+                    engine = replica.scheduler.batching.engine
+                    if engine is not None:
+                        try:
+                            self._store_client(name).capture_engine(engine)
+                        except Exception:
+                            pass  # restart proceeds; the rejoin seeds cold
+                corpse = self.router.remove_replica(name)
+                if corpse is None:
+                    continue
+                # Synchronous drain — the "one at a time" contract.
+                corpse.shutdown(drain=True, timeout=drain_timeout_s)
+                with self._lock:
+                    # A deliberate restart is not a flap.
+                    self._loss_times.pop(name, None)
+                    self._backoffs.pop(name, None)
+                fresh = self._spawn(name, tier, respawn=False)
+                deadline = self._clock() + health_timeout_s
+                while (fresh.health != HEALTHY
+                       and self._clock() < deadline):
+                    time.sleep(poll_interval_s)
+                if fresh.health != HEALTHY:
+                    result["aborted"] = name
+                    get_flight_recorder().record_event(
+                        "rolling_restart_abort", replica=name,
+                        health=fresh.health)
+                    break
+                completed = self._clock()
+                with self._lock:
+                    self.restarts += 1
+                    event = {
+                        "replica": name,
+                        "started_s": started,
+                        "completed_s": completed,
+                        "warm_seeded": self._warm_seeded.get(name, 0),
+                    }
+                    self._restart_events.append(event)
+                    del self._restart_events[:-32]
+                self._m_rolling.inc()
+                result["restarted"].append(name)
+                result["events"].append(dict(event))
+                get_flight_recorder().record_event(
+                    "rolling_restart_member", replica=name,
+                    took_s=completed - started,
+                    warm_seeded=event["warm_seeded"])
+        finally:
+            self._restarting = False
+        return result
+
     # -- spawn / retire -----------------------------------------------------
 
     def _spawn(self, name: str, tier: Optional[str],
@@ -651,9 +764,11 @@ class ReplicaManager:
             engine = replica.scheduler.batching.engine
             if engine is not None:
                 try:
-                    self._store_client(name).seed_engine(engine)
+                    adopted = self._store_client(name).seed_engine(engine)
                 except Exception:
-                    pass  # cold join is a degraded start, not a failure
+                    adopted = 0  # cold join is a degraded start, not a failure
+                with self._lock:
+                    self._warm_seeded[name] = int(adopted or 0)
         self.router.add_replica(replica)
         get_flight_recorder().record_event(
             "respawn" if respawn else "scale_up", replica=name)
@@ -691,6 +806,10 @@ class ReplicaManager:
                     dict(e) for e in self._partition_events],
                 "flap_threshold": self.flap_threshold,
                 "flap_window_s": self.flap_window_s,
+                "restarts": self.restarts,
+                "restarting": self._restarting,
+                "restart_events": [dict(e) for e in self._restart_events],
+                "warm_seeded": dict(self._warm_seeded),
                 "page_store": (
                     self.page_store.stats()
                     if self.page_store is not None else None

@@ -425,6 +425,20 @@ class ConsensusServer:
 
     def start(self) -> "ConsensusServer":
         self.scheduler.start()
+        wal = getattr(self.scheduler, "wal", None)
+        if wal is not None:
+            # Crash recovery: re-admit the previous life's unresolved
+            # journal entries through normal admission BEFORE the HTTP
+            # socket takes new traffic.  Entries whose answers survived
+            # in the durable idempotency snapshot resolve instantly as
+            # idempotent replays; the rest recompute (byte-identical —
+            # everything is (prompt, seed)-keyed).
+            from consensus_tpu.serve.wal import replay_unresolved
+
+            replayed = replay_unresolved(wal, self.scheduler)
+            if replayed:
+                logger.info(
+                    "replayed %d unresolved journal entries", replayed)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="serve-http", daemon=True
         )

@@ -439,6 +439,12 @@ def run_loadgen(
     tier_counts = fetch_tier_counts(base_url)
     if tier_counts is not None:
         report["tier_request_counts"] = tier_counts
+    # Durable-state accounting (PR 20): journal replays from the server's
+    # healthz durability block (single-server WAL path) and, in fleet
+    # mode, rolling-restart events + warm-seed fractions gathered from
+    # the manager snapshot below.  Emitted only when non-empty, so
+    # non-durable runs' reports are unchanged.
+    durability: Dict[str, Any] = {}
     fleet_after = fetch_fleet_stats(base_url)
     if fleet_after is not None:
         # Per-replica placement of the 200s (client view, from served_by)
@@ -518,10 +524,55 @@ def run_loadgen(
             ]
             if seam.get("degradation_windows") or seam["partition_events"]:
                 report["seam_degradation"] = seam
+            # Rolling-restart timeline: per-member drain -> respawn ->
+            # warm-seed -> rejoin events, re-based onto the run timeline
+            # like the seam windows above, plus the fraction of restarted
+            # members that came back with warm prefix pages.
+            restart_events = manager_after.get("restart_events") or []
+            if restart_events:
+                warm = manager_after.get("warm_seeded") or {}
+                durability["rolling_restarts"] = manager_after.get(
+                    "restarts", 0)
+                durability["restart_events"] = [
+                    {
+                        "replica": e.get("replica"),
+                        "started_s": _rel(e.get("started_s")),
+                        "completed_s": _rel(e.get("completed_s")),
+                        "time_to_recover_s": (
+                            round(float(e["completed_s"])
+                                  - float(e["started_s"]), 3)
+                            if e.get("started_s") is not None
+                            and e.get("completed_s") is not None
+                            else None
+                        ),
+                        "warm_seeded_runs": e.get("warm_seeded", 0),
+                    }
+                    for e in restart_events
+                ]
+                restarted = [e.get("replica") for e in restart_events]
+                durability["warm_seed_fraction"] = (
+                    round(
+                        sum(1 for r in restarted if (warm.get(r) or 0) > 0)
+                        / len(restarted), 4)
+                    if restarted else None
+                )
         report["replica_request_counts"] = replica_counts
         report["failover_fraction"] = (
             round(failovers / len(ok), 4) if ok else 0.0
         )
+    server_durability = fetch_durability_stats(base_url)
+    if server_durability is not None:
+        wal = server_durability.get("wal") or {}
+        idem = server_durability.get("idempotency") or {}
+        durability["journal"] = {
+            "replayed": wal.get("replayed", 0),
+            "recovered_unresolved": wal.get("recovered_unresolved", 0),
+            "unresolved": wal.get("unresolved", 0),
+        }
+        if idem:
+            durability["idempotency_restored"] = idem.get("restored", 0)
+    if durability:
+        report["durability"] = durability
     mesh_stats = fetch_engine_mesh(base_url)
     if mesh_stats is not None:
         # Per-dp-shard slot occupancy at run end: under a balanced engine
@@ -638,6 +689,21 @@ def fetch_fleet_stats(base_url: str) -> Optional[Dict[str, Any]]:
         return None
     fleet = health.get("fleet")
     return dict(fleet) if isinstance(fleet, dict) else None
+
+
+def fetch_durability_stats(base_url: str) -> Optional[Dict[str, Any]]:
+    """The ``durability`` block of the server's /healthz (WAL + durable
+    idempotency stats); None when the server runs without ``--state-dir``
+    (single server) or /healthz is down."""
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/healthz", timeout=5.0
+        ) as response:
+            health = json.loads(response.read().decode("utf-8"))
+    except Exception:
+        return None
+    block = health.get("durability")
+    return dict(block) if isinstance(block, dict) else None
 
 
 def fetch_prefix_stats(base_url: str) -> Optional[Dict[str, float]]:

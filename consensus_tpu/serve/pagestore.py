@@ -61,6 +61,9 @@ instead of re-prefilling.
 from __future__ import annotations
 
 import hashlib
+import math
+import os
+import pathlib
 import pickle
 import threading
 import time
@@ -70,6 +73,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from consensus_tpu.obs.metrics import Registry, get_registry
 from consensus_tpu.ops.kv_pages import PagePoolExhausted
 from consensus_tpu.serve.transport import LoopbackTransport, TransportError
+from consensus_tpu.utils.io_atomic import atomic_write_bytes
 
 #: Default bound on retained runs — LRU over capture recency.  Sized so a
 #: scenario-heavy loadgen run (dozens of distinct prompts) fits whole.
@@ -151,6 +155,8 @@ class PageStore:
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
         clock: Callable[[], float] = time.monotonic,
         peer: str = STORE_PEER,
+        spill_dir=None,
+        disk_budget_bytes: Optional[int] = None,
     ):
         self.max_runs = max(1, int(max_runs))
         self.lease_s = None if lease_s is None else float(lease_s)
@@ -158,6 +164,27 @@ class PageStore:
         self.peer = peer
         self._clock = clock
         self._lock = threading.Lock()
+        #: Disk backing (None = memory-only, the pre-durability store).
+        #: Every admitted run is also atomically spilled as
+        #: ``<spill_dir>/<content-hash>.run`` under an LRU byte budget; a
+        #: NEW store over the same directory re-indexes the files (each
+        #: verified against the hash its name claims) and serves them
+        #: lazily — a respawned or upgraded replica warm-seeds from disk
+        #: instead of re-prefilling cold.  Memory eviction never deletes
+        #: disk files; only the disk budget does.
+        self.spill_dir = pathlib.Path(spill_dir) if spill_dir else None
+        self.disk_budget_bytes = (
+            None if disk_budget_bytes is None else max(1, int(disk_budget_bytes))
+        )
+        #: content hash -> {path, size, meta}; insertion order == spill /
+        #: touch recency (LRU for the disk budget).
+        self._disk: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        #: (identity, key) -> content hash, for lazy restore lookups.
+        self._disk_by_key: Dict[Tuple[Tuple, bytes], str] = {}
+        self._disk_bytes = 0
+        self._n_spilled = 0
+        self._n_restored = 0
+        self._n_disk_evicted = 0
         #: (identity, key) -> run dict; insertion order == capture recency
         #: (move_to_end on re-capture), so iteration from the END yields
         #: most-recently-seen first.
@@ -206,6 +233,32 @@ class PageStore:
             "PageStore transport clients currently degraded (seam down "
             "or peer partitioned; replicas fall back to cold prefill).",
         )
+        self._m_spilled = reg.counter(
+            "pagestore_spilled_runs_total",
+            "Prefix-KV runs spilled to the on-disk store (admission-time "
+            "write-through under the disk LRU budget).",
+        )
+        self._m_restored = reg.counter(
+            "pagestore_disk_restores_total",
+            "Runs restored from disk into the in-memory store (lazy, at "
+            "first fetch after a restart).",
+        )
+        self._m_disk_evicted = reg.counter(
+            "pagestore_disk_evictions_total",
+            "Spilled run files evicted (LRU) to stay under the disk "
+            "byte budget.",
+        )
+        self._m_disk_runs = reg.gauge(
+            "pagestore_disk_runs",
+            "Run files currently in the on-disk store.",
+        )
+        self._m_disk_bytes = reg.gauge(
+            "pagestore_disk_bytes",
+            "Bytes currently held by the on-disk store.",
+        )
+        if self.spill_dir is not None:
+            self.spill_dir.mkdir(parents=True, exist_ok=True)
+            self._index_spill_dir()
         self.transport = (
             transport if transport is not None else LoopbackTransport()
         )
@@ -220,6 +273,149 @@ class PageStore:
         with self._lock:
             self._expire_locked()
             return len(self._runs)
+
+    # -- disk backing ---------------------------------------------------------
+
+    def _index_spill_dir(self) -> None:
+        """Re-index spilled run files at construction (restart path).
+
+        Each ``<hash>.run`` file's bytes are verified against the hash
+        its NAME claims — a torn or tampered file is deleted and counted,
+        never indexed.  Files are indexed oldest-first (mtime) so disk
+        LRU order survives the restart; runs are NOT loaded into memory
+        here — restore is lazy, at first fetch."""
+        files = sorted(
+            self.spill_dir.glob("*.run"),
+            key=lambda p: (p.stat().st_mtime, p.name),
+        )
+        for path in files:
+            claimed = path.stem
+            try:
+                blob = path.read_bytes()
+            except OSError:
+                continue
+            if _content_hash(blob) != claimed:
+                self._m_integrity.inc()
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+                continue
+            try:
+                run = _deserialize_run(blob)
+            except Exception:
+                self._m_integrity.inc()
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+                continue
+            self._disk[claimed] = {
+                "path": path,
+                "size": len(blob),
+                "meta": {
+                    "identity": run["identity"],
+                    "key": run["key"],
+                    "page_size": run["page_size"],
+                    "n_tokens": run["n_tokens"],
+                    "n_pages": run["n_pages"],
+                    "hash": claimed,
+                    "blob_len": len(blob),
+                },
+            }
+            self._disk_by_key[(run["identity"], run["key"])] = claimed
+            self._disk_bytes += len(blob)
+        self._m_disk_runs.set(len(self._disk))
+        self._m_disk_bytes.set(self._disk_bytes)
+
+    def _spill_locked(self, run: Dict[str, Any]) -> None:
+        """Write-through one admitted run to disk (caller holds _lock).
+        A run already on disk is just touched (LRU recency); budget
+        overflow evicts coldest files first."""
+        if self.spill_dir is None:
+            return
+        blob_hash = run["hash"]
+        if blob_hash in self._disk:
+            self._disk.move_to_end(blob_hash)
+            return
+        path = self.spill_dir / f"{blob_hash}.run"
+        atomic_write_bytes(path, run["blob"])
+        self._disk[blob_hash] = {
+            "path": path,
+            "size": len(run["blob"]),
+            "meta": {
+                "identity": run["identity"],
+                "key": run["key"],
+                "page_size": run["page_size"],
+                "n_tokens": run["n_tokens"],
+                "n_pages": run["n_pages"],
+                "hash": blob_hash,
+                "blob_len": len(run["blob"]),
+            },
+        }
+        self._disk_by_key[(run["identity"], run["key"])] = blob_hash
+        self._disk_bytes += len(run["blob"])
+        self._n_spilled += 1
+        self._m_spilled.inc()
+        if self.disk_budget_bytes is not None:
+            while (self._disk_bytes > self.disk_budget_bytes
+                   and len(self._disk) > 1):
+                evicted_hash, entry = self._disk.popitem(last=False)
+                self._disk_by_key.pop(
+                    (entry["meta"]["identity"], entry["meta"]["key"]), None)
+                self._disk_bytes -= entry["size"]
+                try:
+                    os.unlink(entry["path"])
+                except OSError:
+                    pass
+                self._n_disk_evicted += 1
+                self._m_disk_evicted.inc()
+        self._m_disk_runs.set(len(self._disk))
+        self._m_disk_bytes.set(self._disk_bytes)
+
+    def _restore_locked(self, identity: Tuple,
+                        key: bytes) -> Optional[Dict[str, Any]]:
+        """Lazily restore one spilled run into the in-memory table
+        (caller holds _lock).  The file's bytes are hash-verified again
+        at restore time (bit rot between index and use); restored runs
+        get a fresh lease.  Returns the run, or None."""
+        blob_hash = self._disk_by_key.get((identity, key))
+        if blob_hash is None:
+            return None
+        entry = self._disk.get(blob_hash)
+        if entry is None:
+            return None
+        try:
+            blob = entry["path"].read_bytes()
+        except OSError:
+            return None
+        if _content_hash(blob) != blob_hash:
+            self._m_integrity.inc()
+            self._disk.pop(blob_hash, None)
+            self._disk_by_key.pop((identity, key), None)
+            self._disk_bytes -= entry["size"]
+            self._m_disk_runs.set(len(self._disk))
+            self._m_disk_bytes.set(self._disk_bytes)
+            try:
+                os.unlink(entry["path"])
+            except OSError:
+                pass
+            return None
+        run = _deserialize_run(blob)
+        run["hash"] = blob_hash
+        run["blob"] = blob
+        if self.lease_s is not None:
+            run["expires_s"] = self._clock() + self.lease_s
+        store_key = (run["identity"], run["key"])
+        self._runs[store_key] = run
+        self._runs.move_to_end(store_key)
+        while len(self._runs) > self.max_runs:
+            self._runs.popitem(last=False)
+        self._m_runs.set(len(self._runs))
+        self._disk.move_to_end(blob_hash)
+        self._n_restored += 1
+        self._m_restored.inc()
+        return run
 
     # -- admission (shared by transport and local paths) ---------------------
 
@@ -254,6 +450,7 @@ class PageStore:
             while len(self._runs) > self.max_runs:
                 self._runs.popitem(last=False)
             self._m_runs.set(len(self._runs))
+            self._spill_locked(run)
         self._m_captured.inc()
         return run
 
@@ -287,6 +484,12 @@ class PageStore:
                         self._runs.move_to_end(
                             (run["identity"], run["key"]))
                         return {"ok": True, "done": True, "have": []}
+                if msg["hash"] in self._disk:
+                    # Known content already durable on disk (e.g. spilled
+                    # before a restart evicted it from memory): no bytes
+                    # need to move.
+                    self._disk.move_to_end(msg["hash"])
+                    return {"ok": True, "done": True, "have": []}
                 transfer = self._transfers.setdefault(msg["transfer"], {
                     "hash": msg["hash"],
                     "n_chunks": int(msg["n_chunks"]),
@@ -364,11 +567,33 @@ class PageStore:
                     }
                     for run in reversed(self._runs.values())
                 ]
+                # Disk-only runs (spilled before a restart or evicted
+                # from memory) list AFTER the in-memory ones: memory
+                # order encodes capture recency, disk is the cold tier.
+                in_memory = {
+                    (run["identity"], run["key"])
+                    for run in self._runs.values()
+                }
+                for entry in reversed(self._disk.values()):
+                    meta = entry["meta"]
+                    if (meta["identity"], meta["key"]) in in_memory:
+                        continue
+                    metas.append(dict(
+                        meta,
+                        n_chunks=max(
+                            1,
+                            math.ceil(meta["blob_len"] / self.chunk_bytes)),
+                    ))
             return {"ok": True, "runs": metas, "chunk_bytes": self.chunk_bytes}
         if phase == "chunk":
             with self._lock:
                 self._expire_locked()
                 run = self._runs.get((tuple(msg["identity"]), msg["key"]))
+                if run is None:
+                    # Not resident: lazily restore from the disk tier
+                    # (hash-verified) before declaring the run gone.
+                    run = self._restore_locked(
+                        tuple(msg["identity"]), msg["key"])
                 if run is None:
                     # Expired or evicted mid-transfer: the client must
                     # abort this adoption, never assemble a partial run.
@@ -440,7 +665,7 @@ class PageStore:
                     "client": name, "enter_s": enter_s, "exit_s": exit_s,
                 })
         windows.sort(key=lambda w: w["enter_s"])
-        return {
+        stats = {
             "runs": len(runs),
             "max_runs": self.max_runs,
             "pages": sum(r["n_pages"] for r in runs),
@@ -452,6 +677,18 @@ class PageStore:
                 name for name, c in clients.items() if c.degraded),
             "degradation_windows": windows,
         }
+        if self.spill_dir is not None:
+            with self._lock:
+                stats["disk"] = {
+                    "spill_dir": str(self.spill_dir),
+                    "runs": len(self._disk),
+                    "bytes": self._disk_bytes,
+                    "budget_bytes": self.disk_budget_bytes,
+                    "spilled": self._n_spilled,
+                    "restored": self._n_restored,
+                    "evicted": self._n_disk_evicted,
+                }
+        return stats
 
     def runs(self) -> List[Dict[str, Any]]:
         """Point-in-time copy of retained runs, most recent first (blob

@@ -38,9 +38,12 @@ Obs families (land in ``metrics.json`` / ``metrics.prom`` / ``/metrics``):
 from __future__ import annotations
 
 import collections
+import dataclasses
 import hashlib
 import inspect
+import json
 import logging
+import pathlib
 import threading
 import time
 from typing import Any, Callable, Deque, Dict, List, Optional
@@ -51,6 +54,8 @@ from consensus_tpu.methods.anytime import BudgetClock, BudgetExpired
 from consensus_tpu.obs.metrics import Registry, get_registry
 from consensus_tpu.obs.trace import trace_current, use_trace
 from consensus_tpu.serve.brownout import BrownoutController
+from consensus_tpu.serve.wal import result_hash as _result_hash
+from consensus_tpu.utils.io_atomic import atomic_write_json
 
 logger = logging.getLogger(__name__)
 
@@ -101,6 +106,10 @@ def idempotency_key(request: Any, method: str = "") -> Optional[str]:
     return h.hexdigest()
 
 
+#: Snapshot file schema for the durable idempotency cache.
+IDEMPOTENCY_SCHEMA = "consensus_tpu.serve.idem.v1"
+
+
 class IdempotencyCache:
     """Bounded LRU of completed results keyed by request identity.
 
@@ -109,24 +118,63 @@ class IdempotencyCache:
     failed-over ticket, so a request whose first replica died AFTER
     computing the answer is resolved from the cache instead of executed a
     second time — zero duplicated requests under chaos, byte-identical
-    re-delivery."""
+    re-delivery.
 
-    def __init__(self, max_entries: int = 1024):
+    With ``snapshot_path`` the cache is DURABLE: entries are atomically
+    snapshotted every ``snapshot_every`` puts (and at drain), and a new
+    cache constructed over the same path restores them — so requests
+    replayed from the WAL after a crash-restart are answered from the
+    snapshot as ``idempotent_replay`` instead of recomputed."""
+
+    def __init__(self, max_entries: int = 1024,
+                 snapshot_path=None, snapshot_every: int = 8):
         self.max_entries = max(1, int(max_entries))
+        self.snapshot_path = (
+            pathlib.Path(snapshot_path) if snapshot_path else None
+        )
+        self.snapshot_every = max(1, int(snapshot_every))
         self._lock = threading.Lock()
         self._entries: "collections.OrderedDict[str, Dict[str, Any]]" = (
             collections.OrderedDict()
         )
         self.hits = 0
         self.puts = 0
+        self.restored = 0
+        if self.snapshot_path is not None and self.snapshot_path.exists():
+            try:
+                payload = json.loads(self.snapshot_path.read_text())
+            except (ValueError, OSError):
+                payload = {}
+            if payload.get("schema") == IDEMPOTENCY_SCHEMA:
+                for key, record in payload.get("entries", []):
+                    self._entries[str(key)] = record
+                self.restored = len(self._entries)
 
     def put(self, key: str, record: Dict[str, Any]) -> None:
+        snap = False
         with self._lock:
             self.puts += 1
             self._entries[key] = record
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
+            snap = (self.snapshot_path is not None
+                    and self.puts % self.snapshot_every == 0)
+        if snap:
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        """Atomic-replace the on-disk snapshot (no-op when not durable).
+        Entries are copied under the lock, written outside it — a crash
+        mid-write leaves the previous complete snapshot in place."""
+        if self.snapshot_path is None:
+            return
+        with self._lock:
+            entries = [[k, v] for k, v in self._entries.items()]
+        atomic_write_json(self.snapshot_path, {
+            "schema": IDEMPOTENCY_SCHEMA,
+            "entries": entries,
+        })
 
     def get(self, key: Optional[str]) -> Optional[Dict[str, Any]]:
         if key is None:
@@ -145,12 +193,16 @@ class IdempotencyCache:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            stats = {
                 "entries": len(self._entries),
                 "max_entries": self.max_entries,
                 "hits": self.hits,
                 "puts": self.puts,
             }
+            if self.snapshot_path is not None:
+                stats["snapshot_path"] = str(self.snapshot_path)
+                stats["restored"] = self.restored
+            return stats
 
 
 class Ticket:
@@ -241,6 +293,7 @@ class RequestScheduler:
         engine_options: Optional[Dict[str, Any]] = None,
         telemetry: Optional[Any] = None,
         idempotency: Optional["IdempotencyCache"] = None,
+        wal: Optional[Any] = None,
     ):
         if max_queue_depth < 1 or max_inflight < 1:
             raise ValueError("max_queue_depth and max_inflight must be >= 1")
@@ -338,6 +391,24 @@ class RequestScheduler:
         #: computing and delivering) returns the SAME bytes instead of
         #: executing twice.
         self.idempotency = idempotency
+        #: Optional :class:`~consensus_tpu.serve.wal.RequestWAL`.  When
+        #: armed, ``submit`` fsyncs an ``admitted`` record before
+        #: returning and ``_finish`` fsyncs the terminal outcome — the
+        #: crash-consistency contract.  None (the default, and always in
+        #: fleet mode where durability rides the shared idempotency
+        #: snapshot + PageStore spill instead) keeps the admission path
+        #: byte-identical to the non-durable build.
+        self.wal = wal
+        self._m_replay_served = (
+            reg.counter(
+                "serve_replay_served_total",
+                "Requests answered from the durable idempotency snapshot "
+                "at admission (WAL replay dedup) instead of recomputed.")
+            if wal is not None else None
+        )
+        #: Monotonic fallback ids for journaling anonymous requests (no
+        #: ``request_id`` → no dedup, but the request is still replayed).
+        self._wal_seq = 0
 
         self._lock = threading.Lock()
         self._work_cv = threading.Condition(self._lock)
@@ -378,6 +449,15 @@ class RequestScheduler:
                             "request was scheduled"),
                     )
                     self._m_rejected.labels("stopped").inc()
+                    if self.wal is not None:
+                        # A deliberate non-drain shutdown FAILS queued
+                        # work (clients were told "stopped"); journal the
+                        # outcome so the sealed journal replays nothing.
+                        wal_id = getattr(ticket, "_wal_id", None)
+                        if wal_id is not None:
+                            self.wal.record_resolved(
+                                wal_id, "failed",
+                                getattr(ticket, "_wal_key", None), None)
                 self._m_queue_depth.set(0)
             while self._queue or self._inflight_count:
                 remaining = None
@@ -396,6 +476,15 @@ class RequestScheduler:
         # Engine mode holds a scheduler thread of its own; release it once
         # no worker can issue further backend calls.
         self.batching.close()
+        # Durable-state epilogue, strictly AFTER the drain completed:
+        # final idempotency snapshot, then seal the journal.  A sealed
+        # journal is the "clean shutdown" marker — the next start replays
+        # nothing.  (The blackbox SIGTERM dump runs after stop() returns,
+        # so it can never capture a half-sealed journal.)
+        if self.idempotency is not None:
+            self.idempotency.snapshot()
+        if self.wal is not None:
+            self.wal.seal()
 
     # -- admission ---------------------------------------------------------
 
@@ -417,6 +506,10 @@ class RequestScheduler:
         active = trace_current()
         if active is not None:
             ticket.trace, ticket._span_parent = active
+        if self.wal is not None:
+            served = self._try_serve_from_snapshot(ticket)
+            if served is not None:
+                return served
         with self._lock:
             if self._stopped or self._draining:
                 self._m_rejected.labels("draining").inc()
@@ -441,11 +534,75 @@ class RequestScheduler:
                 ticket._span_queue = ticket.trace.begin(
                     "queue_wait", parent=ticket._span_parent,
                     replica=self.replica_name)
+            if self.wal is not None:
+                # Fsync'd BEFORE the ticket becomes poppable and before
+                # submit returns: once admission is acknowledged, a kill
+                # cannot lose the request.  Appending under the lock pins
+                # the admitted-before-dispatched ordering.
+                self._journal_admitted(ticket)
             self._queue.append(ticket)
             self._m_accepted.inc()
             self._m_queue_depth.set(len(self._queue))
             self._work_cv.notify()
         self._update_brownout()
+        return ticket
+
+    def _journal_admitted(self, ticket: Ticket) -> None:
+        """Append the ``admitted`` WAL record for one ticket (caller holds
+        ``_lock``).  Anonymous requests get a synthetic per-process id —
+        still journaled and replayed, just never deduplicated."""
+        request = ticket.request
+        method = getattr(request, "method", "unknown")
+        rid = getattr(request, "request_id", "") or ""
+        if not rid:
+            self._wal_seq += 1
+            rid = f"anon-{self._wal_seq}"
+        ticket._wal_id = rid
+        ticket._wal_key = idempotency_key(request, method)
+        payload: Dict[str, Any] = {}
+        if dataclasses.is_dataclass(request):
+            payload = dataclasses.asdict(request)
+        self.wal.record_admitted(rid, ticket._wal_key, payload)
+
+    def _try_serve_from_snapshot(self, ticket: Ticket) -> Optional[Ticket]:
+        """WAL-armed admission dedup: a request whose answer survived in
+        the durable idempotency snapshot is resolved instantly as an
+        ``idempotent_replay`` — never recomputed, and its bytes are
+        cross-checked against the journal's ``result_hash`` (a mismatch
+        is a loud :class:`~consensus_tpu.serve.wal.WALIntegrityError`).
+        Returns the resolved ticket, or None to fall through to normal
+        admission.  Gated on the WAL being armed so the non-durable path
+        stays byte-identical."""
+        if self.idempotency is None:
+            return None
+        request = ticket.request
+        method = getattr(request, "method", "unknown")
+        key = idempotency_key(request, method)
+        record = self.idempotency.get(key) if key is not None else None
+        if record is None:
+            return None
+        rid = getattr(request, "request_id", "") or ""
+        value = record.get("value")
+        if isinstance(value, dict):
+            self.wal.verify_replay(rid, value)
+            value = dict(value)
+            value["idempotent_replay"] = True
+            if record.get("replica"):
+                value["served_by"] = record["replica"]
+            if record.get("tier"):
+                value["served_tier"] = record["tier"]
+        outcome = record.get("outcome", "ok")
+        # The journal still accounts this life's acceptance + resolution.
+        with self._lock:
+            ticket._wal_id = rid or f"replay-{id(ticket):x}"
+            ticket._wal_key = key
+            self.wal.record_admitted(ticket._wal_id, key, {})
+            self.wal.record_resolved(
+                ticket._wal_id, outcome, key, _result_hash(value))
+            self._m_accepted.inc()
+        if self._m_replay_served is not None:
+            self._m_replay_served.inc()
+        ticket._finish(outcome, value=value)
         return ticket
 
     def _update_brownout(self) -> None:
@@ -520,6 +677,13 @@ class RequestScheduler:
             stats["circuit_breaker"] = self.circuit_breaker.snapshot()
         if self.brownout is not None:
             stats["brownout"] = self.brownout.snapshot()
+        if self.wal is not None:
+            # Lands in /healthz via the frontend's stats() passthrough:
+            # journal state + durable idempotency cache in one block.
+            durability: Dict[str, Any] = {"wal": self.wal.stats()}
+            if self.idempotency is not None:
+                durability["idempotency"] = self.idempotency.stats()
+            stats["durability"] = durability
         return stats
 
     # -- workers -----------------------------------------------------------
@@ -710,4 +874,14 @@ class RequestScheduler:
                     "replica": self.replica_name,
                     "tier": self.replica_tier,
                 })
+        if self.wal is not None:
+            # EVERY terminal outcome is journaled — timeouts and failures
+            # too, else a crash after a timeout would replay a request the
+            # client already saw fail.  result_hash only exists for
+            # value-bearing outcomes.
+            wal_id = getattr(ticket, "_wal_id", None)
+            if wal_id is not None:
+                self.wal.record_resolved(
+                    wal_id, outcome, getattr(ticket, "_wal_key", None),
+                    _result_hash(value) if value is not None else None)
         ticket._finish(outcome, value=value, error=error)

@@ -64,6 +64,34 @@ def atomic_write_json(path: PathLike, payload: Any, indent: int = 2) -> None:
     atomic_write_text(path, json.dumps(payload, indent=indent))
 
 
+def sanitize_frame_for_csv(frame):
+    """Replace NUL characters in a DataFrame's string cells with U+FFFD.
+
+    CSV cannot carry ``\\x00`` at all: the writer refuses ("need to
+    escape, but no escapechar set") and readers truncate the cell at the
+    NUL even when one is set.  The only producer of NULs here is garbage
+    token text from random-weight smoke models, and the replacement is
+    deterministic — two runs emitting the same bytes still compare equal
+    after sanitizing."""
+    object_columns = [
+        column for column in frame.columns if frame[column].dtype == object
+    ]
+    dirty = [
+        column for column in object_columns
+        if frame[column].map(
+            lambda v: isinstance(v, str) and "\x00" in v).any()
+    ]
+    if not dirty:
+        return frame
+    frame = frame.copy()
+    for column in dirty:
+        frame[column] = frame[column].map(
+            lambda v: v.replace("\x00", "�")
+            if isinstance(v, str) else v
+        )
+    return frame
+
+
 class JournalWriter:
     """Append-only JSONL journal with per-record fsync.
 
@@ -71,17 +99,22 @@ class JournalWriter:
     rows as they finish.  Each record lands as one line
     ``{"schema": ..., "key": {...}, ...payload}``; the fsync before
     returning is the crash-safety contract — once :meth:`append` returns,
-    the record survives a kill."""
+    the record survives a kill.
 
-    def __init__(self, path: PathLike):
+    ``schema`` defaults to the experiment journal schema; other journal
+    users (the serving WAL) stamp their own so ``read_journal`` can filter
+    records to the schema it understands."""
+
+    def __init__(self, path: PathLike, schema: str = JOURNAL_SCHEMA):
         self.path = pathlib.Path(path)
+        self.schema = schema
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
         self._fh = open(self.path, "a", encoding="utf-8")
 
     def append(self, record: Dict[str, Any]) -> None:
         line = json.dumps(
-            {"schema": JOURNAL_SCHEMA, **record}, ensure_ascii=False
+            {"schema": self.schema, **record}, ensure_ascii=False
         )
         with self._lock:
             self._fh.write(line + "\n")

@@ -65,6 +65,14 @@ DEFAULT_GATES: List[Tuple[str, str, float]] = [
     ("extra.spec_k1_tokens_per_dispatch", "higher", 0.2),
     ("extra.spec_stream_cells.k1_spec.draft_acceptance_rate",
      "higher", 0.5),
+    # Durable-state rolling restart (PR 20): availability through the
+    # full drain->respawn->warm-seed->rejoin cycle should hold >= 0.99;
+    # warm-seed fraction is 1.0 when every respawn restored runs from the
+    # durable PageStore; recovery time is probe-cadence-scale with wide
+    # CPU-smoke bounds.
+    ("extra.restart_availability", "higher", 0.15),
+    ("extra.restart_warm_seed_fraction", "higher", 0.3),
+    ("extra.restart_recovery_time_s", "lower", 1.5),
     # Corpus-driven load (PR 18): throughput and cache hits may wobble on
     # a loaded CI box; the welfare gap is a deterministic fake-backend
     # golden, so ANY drift there is a real fairness regression.

@@ -30,19 +30,25 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def _parse_chaos_schedule(spec):
-    """``'kill:10,kill:25:r1'`` -> ``[(10.0, 'kill', None),
-    (25.0, 'kill', 'r1')]``, sorted by fire time."""
+    """``'kill:10,kill:25:r1,restart:40'`` -> ``[(10.0, 'kill', None),
+    (25.0, 'kill', 'r1'), (40.0, 'restart', None)]``, sorted by fire
+    time.  ``kill`` takes an optional replica NAME; ``restart`` rolls
+    the whole fleet and takes none."""
     events = []
     for item in str(spec).split(","):
         item = item.strip()
         if not item:
             continue
         parts = item.split(":")
-        if len(parts) not in (2, 3) or parts[0] != "kill":
+        if parts[0] == "kill" and len(parts) in (2, 3):
+            events.append((float(parts[1]), "kill",
+                           parts[2] if len(parts) == 3 else None))
+        elif parts[0] == "restart" and len(parts) == 2:
+            events.append((float(parts[1]), "restart", None))
+        else:
             raise ValueError(
-                f"chaos event must be 'kill:S' or 'kill:S:NAME', got {item!r}")
-        events.append((float(parts[1]), parts[0],
-                       parts[2] if len(parts) == 3 else None))
+                "chaos event must be 'kill:S', 'kill:S:NAME' or "
+                f"'restart:S', got {item!r}")
     events.sort(key=lambda e: e[0])
     return events
 
@@ -58,6 +64,24 @@ def _run_chaos(router, schedule, recover_timeout_s, events_out, stop):
     for index, (at_s, kind, name) in enumerate(schedule):
         if stop.wait(max(0.0, start + at_s - time.monotonic())):
             return
+        if kind == "restart":
+            # Rolling restart of the whole fleet: drain -> capture ->
+            # respawn -> warm-seed -> health-gated rejoin, one replica
+            # at a time.  rolling_restart() is synchronous, so its
+            # return doubles as the recovery point.
+            event = {"kind": kind, "at_s": at_s, "replica": None,
+                     "recovered_s": None}
+            events_out.append(event)
+            manager = getattr(router, "manager", None)
+            if manager is None:
+                continue
+            fired = time.monotonic()
+            outcome = manager.rolling_restart()
+            event["restarted"] = outcome.get("restarted")
+            event["aborted"] = outcome.get("aborted")
+            if outcome.get("aborted") is None:
+                event["recovered_s"] = round(time.monotonic() - fired, 3)
+            continue
         target = name
         if target is None:
             live = [r.name for r in router.replicas if not r.lost]
@@ -207,13 +231,16 @@ def main(argv=None) -> int:
                              "the elastic ladder respawns it")
     parser.add_argument("--chaos-schedule", default=None, metavar="EVENTS",
                         help="(self-contained, fleet) comma-separated "
-                             "fault events, each 'kill:S' or "
-                             "'kill:S:NAME' — kill a replica S seconds "
-                             "into the run (NAME defaults to the first "
-                             "live replica at fire time).  Repeated kills "
-                             "exercise the elastic respawn path; the "
-                             "report gains a 'chaos' block with per-event "
-                             "time-to-recover and the fleet respawn count")
+                             "fault events: 'kill:S' or 'kill:S:NAME' — "
+                             "kill a replica S seconds into the run (NAME "
+                             "defaults to the first live replica at fire "
+                             "time) — or 'restart:S' — roll the whole "
+                             "fleet through drain -> capture -> respawn "
+                             "-> warm-seed, one replica at a time.  "
+                             "Repeated kills exercise the elastic respawn "
+                             "path; the report gains a 'chaos' block with "
+                             "per-event time-to-recover and the fleet "
+                             "respawn count")
     parser.add_argument("--chaos-recover-timeout-s", type=float,
                         default=30.0,
                         help="cap on the per-event recovery poll (fleet "
@@ -226,6 +253,13 @@ def main(argv=None) -> int:
     parser.add_argument("--kill-replica", default="r0", metavar="NAME",
                         help="(self-contained, fleet) which replica "
                              "--kill-replica-at-s kills (default: r0)")
+    parser.add_argument("--state-dir", default=None, metavar="DIR",
+                        help="(self-contained) arm the durable-state "
+                             "layer under DIR: fsync'd request WAL + "
+                             "idempotency snapshots (single server) and "
+                             "the disk-backed PageStore spill tier "
+                             "(elastic fleets); the report gains a "
+                             "'durability' block")
     parser.add_argument("--telemetry", action="store_true",
                         help="(self-contained) enable the welfare "
                              "telemetry plane (latency + welfare quantile "
@@ -334,6 +368,7 @@ def main(argv=None) -> int:
             mesh=args.mesh,
             telemetry=args.telemetry,
             slo=args.slo,
+            state_dir=args.state_dir,
         ).start()
         schedule = (_parse_chaos_schedule(args.chaos_schedule)
                     if args.chaos_schedule else [])
@@ -379,7 +414,9 @@ def main(argv=None) -> int:
                     "manager") or {}
                 report["chaos"] = {
                     "events": chaos_events,
-                    "kills": len(chaos_events),
+                    "kills": sum(1 for e in chaos_events
+                                 if e["kind"] == "kill"),
+                    "rolling_restarts": manager.get("restarts", 0),
                     "recovered": len(recovered),
                     "respawns": manager.get("respawns", 0),
                     "time_to_recover_s": {

@@ -1,0 +1,378 @@
+"""Durable serving state, end to end (PR 20).
+
+Four proofs, all hardware-free:
+
+* **crash -> replay -> re-ask**: a durable server loses its process with
+  a journaled-but-unresolved request; the relaunch replays it through
+  normal admission and every re-ask is served byte-identically from the
+  idempotency cache (``idempotent_replay``).
+* **durability OFF is byte-identical to the PR 19 path**: without
+  ``--state-dir`` the scheduler carries no WAL, no durability block, and
+  the same seeded request produces the same answer hash.
+* **shutdown ordering** (drain -> WAL seal -> blackbox dump) is pinned
+  against the SIGTERM regression where the flight recorder dumped a
+  half-sealed journal.
+* **rolling restart** of a 3-replica elastic fleet: every member cycles
+  through drain -> capture -> respawn -> warm-seed with zero aborts, a
+  warm PageStore seed on every respawn, and no quarantine flaps; the
+  disk spill tier behind it is unit-tested directly.
+"""
+
+import dataclasses
+import json
+import urllib.request
+
+import pytest
+
+from consensus_tpu.obs.metrics import Registry
+from consensus_tpu.serve import create_server, parse_request
+from consensus_tpu.serve.pagestore import (
+    PageStore,
+    _content_hash,
+    _serialize_run,
+)
+from consensus_tpu.serve.wal import result_hash
+
+ISSUE = "Should we invest in public transport?"
+OPINIONS = {
+    "Agent 1": "Yes, buses and trains are vital public goods.",
+    "Agent 2": "Only alongside congestion pricing for cars.",
+}
+PARAMS = {"n": 4, "max_tokens": 24}
+
+
+def _payload(seed=7, request_id="", **overrides):
+    payload = {
+        "issue": ISSUE,
+        "agent_opinions": OPINIONS,
+        "method": "best_of_n",
+        "params": dict(PARAMS),
+        "seed": seed,
+        "evaluate": False,
+        "request_id": request_id,
+    }
+    payload.update(overrides)
+    return payload
+
+
+def _post(base_url, payload, timeout=30.0):
+    request = urllib.request.Request(
+        base_url + "/v1/consensus",
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(request, timeout=timeout) as response:
+        return json.loads(response.read().decode())
+
+
+def _healthz(base_url):
+    with urllib.request.urlopen(base_url + "/healthz", timeout=10.0) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _durable_server(state_dir):
+    return create_server(
+        backend="fake", port=0, max_inflight=2, max_queue_depth=16,
+        registry=Registry(), state_dir=state_dir,
+    )
+
+
+def _wait_for(predicate, timeout_s=20.0, interval_s=0.02):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# crash -> replay -> idempotent re-ask
+# ---------------------------------------------------------------------------
+
+
+class TestCrashReplay:
+    def test_crash_replay_and_idempotent_reask(self, tmp_path):
+        # -- life 1: resolve A; leave B journaled-but-unresolved ----------
+        life1 = _durable_server(tmp_path).start()
+        answer_a = _post(life1.base_url, _payload(seed=11, request_id="A"))
+        # B is admitted exactly the way a crash leaves it: an fsync'd
+        # `admitted` record with no terminal outcome.  (Appending it
+        # directly — rather than racing a SIGKILL against the worker
+        # pool — makes the journal state deterministic; the REAL
+        # process-death path is scripts/durability_smoke.py.)
+        wal = life1.scheduler.wal
+        request_b = parse_request(_payload(seed=12, request_id="B"))
+        wal.record_admitted("B", None, dataclasses.asdict(request_b))
+        wal.close()  # crash: journal left unsealed, lease left on disk
+        life1.stop()  # free the socket; seal is a no-op on a closed WAL
+
+        # -- life 2: replay B, then serve every re-ask from the cache ----
+        life2 = _durable_server(tmp_path).start()
+        try:
+            stats = life2.scheduler.wal.stats()
+            assert stats["recovered_sealed"] is False
+            assert stats["replayed"] == 1
+            assert _wait_for(
+                lambda: life2.scheduler.wal.stats()["unresolved"] == 0)
+
+            reask_a = _post(life2.base_url, _payload(seed=11,
+                                                     request_id="A"))
+            assert reask_a["idempotent_replay"] is True
+            assert reask_a["statement"] == answer_a["statement"]
+            assert result_hash(reask_a) == result_hash(answer_a)
+
+            first_b = _post(life2.base_url, _payload(seed=12,
+                                                     request_id="B"))
+            second_b = _post(life2.base_url, _payload(seed=12,
+                                                      request_id="B"))
+            assert first_b["idempotent_replay"] is True  # replay resolved it
+            assert second_b["idempotent_replay"] is True
+            assert first_b["statement"] == second_b["statement"]
+
+            durability = _healthz(life2.base_url)["durability"]
+            assert durability["wal"]["replayed"] == 1
+            assert durability["wal"]["unresolved"] == 0
+            assert durability["idempotency"]["restored"] >= 1
+        finally:
+            life2.stop()
+
+        # -- life 3: the clean stop sealed the journal --------------------
+        life3 = _durable_server(tmp_path)
+        stats = life3.scheduler.wal.stats()
+        assert stats["recovered_sealed"] is True
+        assert stats["recovered_unresolved"] == 0
+
+    def test_replay_is_byte_identical_to_precrash_answer(self, tmp_path):
+        life1 = _durable_server(tmp_path).start()
+        original = _post(life1.base_url, _payload(seed=21, request_id="X"))
+        life1.scheduler.wal.close()  # crash before the seal
+        life1.stop()
+
+        life2 = _durable_server(tmp_path).start()
+        try:
+            replayed = _post(life2.base_url, _payload(seed=21,
+                                                      request_id="X"))
+            assert replayed["idempotent_replay"] is True
+            assert result_hash(replayed) == result_hash(original)
+        finally:
+            life2.stop()
+
+
+# ---------------------------------------------------------------------------
+# durability OFF == the PR 19 path
+# ---------------------------------------------------------------------------
+
+
+class TestDurabilityOffByteIdentity:
+    def test_no_state_dir_means_no_wal_and_identical_answers(self, tmp_path):
+        plain = create_server(
+            backend="fake", port=0, max_inflight=2, registry=Registry(),
+        ).start()
+        try:
+            assert plain.scheduler.wal is None
+            # request_id pinned: anonymous requests get a process-global
+            # server stamp, which would differ between any two servers.
+            baseline = _post(plain.base_url, _payload(seed=31,
+                                                      request_id="pin-31"))
+            health = _healthz(plain.base_url)
+            assert "durability" not in health
+            assert "durability" not in plain.scheduler.stats()
+        finally:
+            plain.stop()
+
+        durable = _durable_server(tmp_path).start()
+        try:
+            answer = _post(durable.base_url, _payload(seed=31,
+                                                      request_id="pin-31"))
+            assert result_hash(answer) == result_hash(baseline)
+            assert "durability" in _healthz(durable.base_url)
+        finally:
+            durable.stop()
+
+
+# ---------------------------------------------------------------------------
+# shutdown ordering: drain -> WAL seal -> blackbox dump
+# ---------------------------------------------------------------------------
+
+
+class TestShutdownOrdering:
+    def test_drain_completes_before_blackbox_dump(self, monkeypatch):
+        from consensus_tpu.serve.__main__ import _shutdown
+
+        order = []
+
+        class _Server:
+            def stop(self, drain=True):
+                assert drain is True
+                order.append("drain")
+
+        class _Recorder:
+            def dump(self, reason):
+                order.append(f"dump:{reason}")
+
+        monkeypatch.setattr(
+            "consensus_tpu.obs.trace.get_flight_recorder",
+            lambda: _Recorder())
+        _shutdown(_Server(), "sigterm")
+        assert order == ["drain", "dump:sigterm"]
+
+    def test_clean_exit_drains_without_dumping(self, monkeypatch):
+        from consensus_tpu.serve.__main__ import _shutdown
+
+        order = []
+
+        class _Server:
+            def stop(self, drain=True):
+                order.append("drain")
+
+        class _Recorder:
+            def dump(self, reason):  # pragma: no cover - the regression
+                order.append("dump")
+
+        monkeypatch.setattr(
+            "consensus_tpu.obs.trace.get_flight_recorder",
+            lambda: _Recorder())
+        _shutdown(_Server(), "exit")
+        assert order == ["drain"]
+
+
+# ---------------------------------------------------------------------------
+# rolling restart: zero-loss fleet cycling with warm seeds
+# ---------------------------------------------------------------------------
+
+
+class TestRollingRestart:
+    def test_three_replica_fleet_cycles_with_warm_seeds(self, tmp_path):
+        registry = Registry()
+        server = create_server(
+            backend="fake", port=0, registry=registry,
+            max_inflight=2, max_queue_depth=16,
+            fleet_size=3,
+            fleet_options={
+                "elastic": True,
+                "elastic_options": {"check_interval_s": 0.05,
+                                    "respawn_backoff_s": 0.05,
+                                    "harvest_interval_s": 0.05},
+            },
+            engine=True,
+            engine_options={"prefix_cache": True},
+            state_dir=tmp_path,
+        ).start()
+        router = server.scheduler
+        manager = router.manager
+        try:
+            # Warm the prefix caches (and therefore the harvested
+            # PageStore) with a few scenario-repeating requests.
+            baseline = {}
+            for seed in (41, 42, 43, 44):
+                baseline[seed] = _post(
+                    server.base_url, _payload(seed=seed))["statement"]
+            assert _wait_for(
+                lambda: (manager.snapshot()["page_store"] or {}).get(
+                    "runs", 0) > 0)
+
+            result = manager.rolling_restart()
+            assert result["aborted"] is None
+            assert sorted(result["restarted"]) == ["r0", "r1", "r2"]
+
+            snap = manager.snapshot()
+            assert snap["restarts"] == 3
+            assert snap["quarantined"] == {}  # a restart is not a flap
+            # Acceptance: warm-seed hit on EVERY respawned replica.
+            for name in ("r0", "r1", "r2"):
+                assert snap["warm_seeded"].get(name, 0) > 0, name
+            for event in snap["restart_events"]:
+                assert event["completed_s"] >= event["started_s"]
+                assert event["warm_seeded"] > 0
+
+            # The restarted fleet serves byte-identically.
+            for seed, statement in baseline.items():
+                assert _post(
+                    server.base_url,
+                    _payload(seed=seed))["statement"] == statement
+            # The spill tier persisted runs on disk for the NEXT process.
+            assert list((tmp_path / "pages").glob("*.run"))
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# disk-backed PageStore spill tier
+# ---------------------------------------------------------------------------
+
+
+def _run_blob(token, identity=("tier", "fp32", 1), page_size=4):
+    tokens = tuple(range(token, token + 8))
+    run = {
+        "identity": identity,
+        "key": bytes([token % 256]) * 8,
+        "tokens": tokens,
+        "n_tokens": len(tokens),
+        "page_size": page_size,
+        "n_pages": 2,
+        "payload": bytes([token % 256]) * 64,
+    }
+    blob = _serialize_run(run)
+    return run, blob, _content_hash(blob)
+
+
+class TestPageStoreDiskTier:
+    def test_admissions_spill_and_reindex_across_restart(self, tmp_path):
+        store = PageStore(registry=Registry(), spill_dir=tmp_path)
+        _, blob, blob_hash = _run_blob(1)
+        store.admit_blob(blob, blob_hash)
+        assert (tmp_path / f"{blob_hash}.run").exists()
+
+        # A NEW store over the same dir re-indexes lazily (nothing in
+        # memory) and restores the run — hash-verified — at first fetch.
+        reborn = PageStore(registry=Registry(), spill_dir=tmp_path)
+        stats = reborn.stats()
+        assert stats["disk"]["runs"] == 1
+        assert stats["runs"] == 0
+        client = reborn.client("test")
+        listing = client._call("fetch", {"phase": "list"})
+        assert listing["ok"] and len(listing["runs"]) == 1
+        fetched = client._fetch_blob(listing["runs"][0])
+        assert fetched == blob
+        assert reborn.stats()["disk"]["restored"] == 1
+
+    def test_corrupt_spill_file_is_refused_at_index(self, tmp_path):
+        store = PageStore(registry=Registry(), spill_dir=tmp_path)
+        _, blob, blob_hash = _run_blob(2)
+        store.admit_blob(blob, blob_hash)
+        path = tmp_path / f"{blob_hash}.run"
+        path.write_bytes(blob[:-1] + b"\x00")  # bit rot
+
+        reborn = PageStore(registry=Registry(), spill_dir=tmp_path)
+        assert reborn.stats()["disk"]["runs"] == 0
+        assert not path.exists()  # refused AND removed
+
+    def test_disk_budget_evicts_lru(self, tmp_path):
+        _, blob, _ = _run_blob(3)
+        store = PageStore(
+            registry=Registry(), spill_dir=tmp_path,
+            disk_budget_bytes=2 * len(blob) + 1,
+        )
+        hashes = []
+        for token in (3, 4, 5):
+            _, blob, blob_hash = _run_blob(token)
+            store.admit_blob(blob, blob_hash)
+            hashes.append(blob_hash)
+        stats = store.stats()["disk"]
+        assert stats["evicted"] >= 1
+        assert not (tmp_path / f"{hashes[0]}.run").exists()  # oldest out
+        assert (tmp_path / f"{hashes[-1]}.run").exists()
+
+    def test_memory_eviction_keeps_disk_files(self, tmp_path):
+        store = PageStore(
+            max_runs=1, registry=Registry(), spill_dir=tmp_path)
+        for token in (6, 7):
+            _, blob, blob_hash = _run_blob(token)
+            store.admit_blob(blob, blob_hash)
+        stats = store.stats()
+        assert stats["runs"] == 1  # memory LRU evicted the first
+        assert stats["disk"]["runs"] == 2  # disk kept both

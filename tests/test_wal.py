@@ -1,0 +1,186 @@
+"""Request-WAL primitives (serve/wal.py): torn tails, replay plans,
+resolved-twice rejection, replay hash verification, and the lease.
+
+Every test drives :class:`RequestWAL` directly against a tmp_path state
+dir — the scheduler/server integration (real crash -> replay -> re-ask)
+lives in tests/test_durability.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from consensus_tpu.obs.metrics import Registry
+from consensus_tpu.serve.wal import (
+    DEFAULT_LEASE_TTL_S,
+    LEASE_FILENAME,
+    WAL_FILENAME,
+    WALIntegrityError,
+    WALLeaseHeld,
+    RequestWAL,
+    result_hash,
+)
+
+
+def _wal(tmp_path, **kwargs):
+    kwargs.setdefault("registry", Registry())
+    return RequestWAL(tmp_path, **kwargs)
+
+
+class TestResultHash:
+    def test_volatile_keys_do_not_change_the_hash(self):
+        base = {"statement": "s", "welfare": {"egalitarian": 0.5}}
+        stamped = dict(base, generation_time_s=1.23, served_by="r1",
+                       served_tier="full", idempotent_replay=True)
+        assert result_hash(base) == result_hash(stamped)
+
+    def test_answer_changes_change_the_hash(self):
+        assert result_hash({"statement": "a"}) != result_hash(
+            {"statement": "b"})
+
+    def test_non_dict_hashes_to_none(self):
+        assert result_hash(None) is None
+        assert result_hash("text") is None
+
+
+class TestJournalLifecycle:
+    def test_admitted_without_resolved_is_the_replay_plan(self, tmp_path):
+        wal = _wal(tmp_path)
+        wal.record_admitted("r-1", "k1", {"issue": "a"})
+        wal.record_admitted("r-2", "k2", {"issue": "b"})
+        wal.record_resolved("r-1", "completed", "k1", "hash1")
+        wal.close()  # crash: no seal
+
+        recovered = _wal(tmp_path)
+        plan = recovered.unresolved()
+        assert [r["request_id"] for r in plan] == ["r-2"]
+        assert plan[0]["request"] == {"issue": "b"}
+        assert recovered.recovered_sealed is False
+        assert recovered.stats()["recovered_unresolved"] == 1
+
+    def test_sealed_journal_replays_nothing(self, tmp_path):
+        wal = _wal(tmp_path)
+        wal.record_admitted("r-1", "k1", {"issue": "a"})
+        wal.record_resolved("r-1", "completed", "k1", None)
+        wal.seal()
+
+        recovered = _wal(tmp_path)
+        assert recovered.unresolved() == []
+        assert recovered.recovered_sealed is True
+
+    def test_torn_tail_is_truncated_on_replay(self, tmp_path):
+        wal = _wal(tmp_path)
+        wal.record_admitted("r-1", "k1", {"issue": "a"})
+        wal.record_admitted("r-2", "k2", {"issue": "b"})
+        wal.close()
+        # Simulate the crash tearing the final line mid-write: r-2's
+        # admitted record loses its tail.  The record was never
+        # acknowledged, so dropping it is lossless — only r-1 replays.
+        path = tmp_path / WAL_FILENAME
+        lines = path.read_bytes().splitlines(keepends=True)
+        path.write_bytes(b"".join(lines[:-1]) + lines[-1][: len(lines[-1]) // 2])
+
+        recovered = _wal(tmp_path)
+        assert [r["request_id"] for r in recovered.unresolved()] == ["r-1"]
+
+    def test_resolved_twice_is_rejected(self, tmp_path):
+        wal = _wal(tmp_path)
+        wal.record_admitted("r-1", "k1", {"issue": "a"})
+        wal.record_resolved("r-1", "completed", "k1", "h")
+        with pytest.raises(WALIntegrityError):
+            wal.record_resolved("r-1", "completed", "k1", "h")
+
+    def test_resolved_without_admitted_is_rejected(self, tmp_path):
+        wal = _wal(tmp_path)
+        with pytest.raises(WALIntegrityError):
+            wal.record_resolved("ghost", "completed", None, None)
+
+    def test_readmission_after_crash_restart_is_legal(self, tmp_path):
+        # An entry may be admitted once per life; the recovered WAL must
+        # accept the replay's re-admission and its (single) resolution.
+        wal = _wal(tmp_path)
+        wal.record_admitted("r-1", "k1", {"issue": "a"})
+        wal.close()
+        recovered = _wal(tmp_path)
+        recovered.record_admitted("r-1", "k1", {"issue": "a"})
+        recovered.record_resolved("r-1", "completed", "k1", "h")
+        assert recovered.stats()["unresolved"] == 0
+
+
+class TestReplayIdempotency:
+    def test_matching_hash_passes_verification(self, tmp_path):
+        value = {"statement": "s", "welfare": {"egalitarian": 0.4}}
+        wal = _wal(tmp_path)
+        wal.record_admitted("r-1", "k1", {"issue": "a"})
+        wal.record_resolved("r-1", "completed", "k1", result_hash(value))
+        wal.close()
+
+        recovered = _wal(tmp_path)
+        # A replay may carry different volatile stamps; only the answer
+        # must match the journaled hash.
+        recovered.verify_replay("r-1", dict(value, served_by="r9",
+                                            idempotent_replay=True))
+
+    def test_mismatching_hash_is_a_loud_integrity_error(self, tmp_path):
+        wal = _wal(tmp_path)
+        wal.record_admitted("r-1", "k1", {"issue": "a"})
+        wal.record_resolved(
+            "r-1", "completed", "k1", result_hash({"statement": "original"}))
+        wal.close()
+
+        recovered = _wal(tmp_path)
+        with pytest.raises(WALIntegrityError):
+            recovered.verify_replay("r-1", {"statement": "DIFFERENT"})
+
+    def test_unrecorded_request_passes_vacuously(self, tmp_path):
+        wal = _wal(tmp_path)
+        wal.verify_replay("never-seen", {"statement": "anything"})
+
+
+class TestLease:
+    def test_fresh_foreign_lease_refuses_takeover(self, tmp_path):
+        clock = [1000.0]
+        first = _wal(tmp_path, clock=lambda: clock[0], owner="server-A")
+        assert first.stats()["lease_owner"] == "server-A"
+        # A second process arrives while A's lease is fresh.
+        with pytest.raises(WALLeaseHeld):
+            _wal(tmp_path, clock=lambda: clock[0] + 1.0, owner="server-B")
+
+    def test_stale_lease_is_taken_over(self, tmp_path):
+        clock = [1000.0]
+        _wal(tmp_path, clock=lambda: clock[0], owner="server-A")
+        clock[0] += DEFAULT_LEASE_TTL_S + 1.0
+        taken = _wal(tmp_path, clock=lambda: clock[0], owner="server-B")
+        lease = json.loads((tmp_path / LEASE_FILENAME).read_text())
+        assert lease["owner"] == "server-B"
+        assert taken.stats()["lease_owner"] == "server-B"
+
+    def test_same_owner_reacquires_its_own_fresh_lease(self, tmp_path):
+        clock = [1000.0]
+        _wal(tmp_path, clock=lambda: clock[0], owner="server-A").close()
+        _wal(tmp_path, clock=lambda: clock[0] + 1.0, owner="server-A")
+
+    def test_dead_pid_lease_is_stale_regardless_of_ttl(self, tmp_path):
+        # The default owner is pid-<N>; a SIGKILL'd server's replacement
+        # must not wait out the TTL when the holder is provably dead.
+        wal = _wal(tmp_path)
+        wal.close()
+        lease = json.loads((tmp_path / LEASE_FILENAME).read_text())
+        assert lease["owner"] == f"pid-{os.getpid()}"
+        dead = 2 ** 22 + (os.getpid() % 1000)  # beyond default pid_max
+        (tmp_path / LEASE_FILENAME).write_text(json.dumps(
+            {"owner": f"pid-{dead}", "expires_at": lease["expires_at"]}))
+        taken = _wal(tmp_path)
+        assert taken.stats()["lease_owner"] == f"pid-{os.getpid()}"
+
+    def test_seal_releases_the_lease(self, tmp_path):
+        wal = _wal(tmp_path)
+        assert (tmp_path / LEASE_FILENAME).exists()
+        wal.seal()
+        assert not (tmp_path / LEASE_FILENAME).exists()
+
+    def test_crash_close_leaves_the_lease_on_disk(self, tmp_path):
+        wal = _wal(tmp_path)
+        wal.close()
+        assert (tmp_path / LEASE_FILENAME).exists()
